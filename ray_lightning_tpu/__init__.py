@@ -1,0 +1,63 @@
+"""ray_lightning_tpu — a TPU-native distributed training framework.
+
+Built from scratch with the capability surface of ``ray_lightning``
+(`/root/reference`): drop-in trainer plugins that launch and manage
+distributed training workers from a single driver script, plus Tune-style
+hyperparameter sweeps.  Where the reference glues together PyTorch
+Lightning + Ray + torch.distributed (NCCL), this framework is one coherent
+TPU-first system:
+
+- compute path: JAX/XLA — every training step is a single pjit'd SPMD
+  program over a ``jax.sharding.Mesh``; gradient sync, ZeRO sharding and
+  tensor/sequence parallelism are expressed as sharding annotations and
+  compiled to ICI/DCN collectives by XLA (vs. the reference's
+  DistributedDataParallel allreduce hooks, ray_ddp.py:467-468).
+- orchestration: an actor runtime (``ray_lightning_tpu.cluster``) with a
+  built-in subprocess backend and an optional Ray backend — one actor per
+  TPU host (vs. one process per GPU, ray_ddp.py:174-186).
+- rendezvous: the PJRT coordination service (``jax.distributed``) replaces
+  the MASTER_ADDR/MASTER_PORT TCP store (ray_ddp.py:206-219).
+
+Public API parity map (reference → here):
+  ``RayPlugin``            → :class:`RayXlaPlugin`        (data parallel)
+  ``RayShardedPlugin``     → :class:`RayXlaShardedPlugin` (ZeRO-1)
+  ``HorovodRayPlugin``     → subsumed by :class:`RayXlaPlugin` (single
+                             collective fabric on TPU; BASELINE north star)
+  ``pl.Trainer``           → :class:`Trainer`
+  ``pl.LightningModule``   → :class:`LightningModule`
+  ``ray_lightning.tune``   → :mod:`ray_lightning_tpu.tune`
+"""
+
+from ray_lightning_tpu.core.module import LightningModule, StepContext
+from ray_lightning_tpu.core.datamodule import LightningDataModule
+from ray_lightning_tpu.core.data import DataLoader
+from ray_lightning_tpu.core.trainer import Trainer
+from ray_lightning_tpu.core.callbacks import (
+    Callback,
+    EarlyStopping,
+    ModelCheckpoint,
+)
+from ray_lightning_tpu.utils.seed import seed_everything
+from ray_lightning_tpu.plugins import (
+    RayXlaPlugin,
+    RayXlaShardedPlugin,
+    RayXlaSpmdPlugin,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "LightningModule",
+    "StepContext",
+    "LightningDataModule",
+    "DataLoader",
+    "Trainer",
+    "Callback",
+    "EarlyStopping",
+    "ModelCheckpoint",
+    "seed_everything",
+    "RayXlaPlugin",
+    "RayXlaShardedPlugin",
+    "RayXlaSpmdPlugin",
+    "__version__",
+]

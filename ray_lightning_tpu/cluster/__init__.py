@@ -1,0 +1,19 @@
+from ray_lightning_tpu.cluster.backend import (
+    ActorHandle,
+    ClusterBackend,
+    Future,
+    get_backend,
+    set_backend,
+)
+from ray_lightning_tpu.cluster.executor import RLTExecutor
+from ray_lightning_tpu.cluster.local import LocalBackend
+
+__all__ = [
+    "ActorHandle",
+    "ClusterBackend",
+    "Future",
+    "get_backend",
+    "set_backend",
+    "LocalBackend",
+    "RLTExecutor",
+]

@@ -1,0 +1,131 @@
+"""Cluster backend abstraction.
+
+The reference hard-depends on Ray core for actor placement, object
+transport and queues (SURVEY.md §2.2 "Ray core" row).  Here those roles
+sit behind one small interface with two implementations:
+
+- :class:`~ray_lightning_tpu.cluster.local.LocalBackend` — built-in,
+  zero-dependency subprocess actors (always available; used by tests the
+  way the reference tests run against a local ``ray.init``).
+- ``RayBackend`` (cluster/ray_backend.py) — real Ray actors with TPU
+  resource labels, used automatically when Ray is importable and
+  connected.
+
+Only control, pickled specs and metrics ride this plane — gradients never
+do (they ride ICI/DCN via XLA collectives), matching the reference's
+"Ray is never on the gradient path" invariant (SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+
+class Future:
+    """Resolvable handle for an in-flight actor call (ObjectRef analog)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+    def set_result(self, value: Any) -> None:
+        self._value = value
+        self._event.set()
+
+    def set_error(self, err: BaseException) -> None:
+        self._error = err
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError("actor call timed out")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class ActorHandle:
+    """Handle to a remote actor; ``call`` is async, returning a Future."""
+
+    actor_id: str
+
+    def call(self, method: str, *args, **kwargs) -> Future:
+        raise NotImplementedError
+
+    def kill(self) -> None:
+        raise NotImplementedError
+
+
+class ClusterBackend:
+    """Actor lifecycle + object transport + worker→driver queue."""
+
+    #: True when ``put`` stores into a shared object store that actors can
+    #: dereference (fan-out ships the payload once instead of per-worker).
+    supports_object_store: bool = False
+
+    def create_actor(
+        self,
+        actor_cls: type,
+        *args,
+        env: Optional[dict[str, str]] = None,
+        resources: Optional[dict[str, float]] = None,
+        name: Optional[str] = None,
+        **kwargs,
+    ) -> ActorHandle:
+        raise NotImplementedError
+
+    def put(self, obj: Any) -> Any:
+        """Store an object once for fan-out to actors (ray.put analog,
+        ray_ddp.py:331)."""
+        raise NotImplementedError
+
+    def get(self, ref: Any) -> Any:
+        raise NotImplementedError
+
+    def queue_get_nowait(self):
+        """Pop one worker→driver queue item or None."""
+        raise NotImplementedError
+
+    def available_resources(self) -> dict[str, float]:
+        return {}
+
+    def shutdown(self) -> None:
+        raise NotImplementedError
+
+
+_backend_lock = threading.Lock()
+_backend: Optional[ClusterBackend] = None
+
+
+def get_backend(prefer_ray: bool = True) -> ClusterBackend:
+    """Return the process-wide backend, creating one if needed.
+
+    Prefers a real Ray runtime when importable (and initializes it,
+    matching ``ray.init()``-if-needed at ray_ddp.py:125-126); falls back
+    to the built-in local backend.
+    """
+    global _backend
+    with _backend_lock:
+        if _backend is not None:
+            return _backend
+        if prefer_ray:
+            from ray_lightning_tpu.utils.imports import RAY_AVAILABLE
+            if RAY_AVAILABLE:
+                from ray_lightning_tpu.cluster.ray_backend import RayBackend
+                _backend = RayBackend()
+                return _backend
+        from ray_lightning_tpu.cluster.local import LocalBackend
+        _backend = LocalBackend()
+        return _backend
+
+
+def set_backend(backend: Optional[ClusterBackend]) -> None:
+    """Install (or clear) the process-wide backend (tests use this)."""
+    global _backend
+    with _backend_lock:
+        _backend = backend

@@ -1,0 +1,55 @@
+"""Generic remote executor actor (``RayExecutor`` parity,
+ray_ddp.py:38-63): run arbitrary functions, set env vars, report
+topology facts.  The same class runs under both the built-in backend and
+real Ray (it has no backend-specific state)."""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+from ray_lightning_tpu.cluster.protocol import find_free_port, node_ip
+
+
+class RLTExecutor:
+    """One instance per worker process (per TPU host)."""
+
+    def __init__(self, env: Optional[dict] = None):
+        if env:
+            self.set_env_vars(env)
+
+    # -- generic execution (ray_ddp.py:61-63 analog) ---------------------
+
+    def execute(self, fn: Callable, *args, **kwargs):
+        return fn(*args, **kwargs)
+
+    # -- env plumbing (ray_ddp.py:44-55 analog) --------------------------
+
+    def set_env_var(self, key: str, value: str) -> None:
+        os.environ[key] = str(value)
+
+    def set_env_vars(self, env: dict) -> None:
+        for k, v in env.items():
+            self.set_env_var(k, v)
+
+    # -- topology discovery (ray_ddp.py:57-63, :282-306 analog) ----------
+
+    def get_node_ip(self) -> str:
+        return node_ip()
+
+    def get_free_port(self) -> int:
+        return find_free_port()
+
+    def get_node_and_device_info(self) -> dict:
+        """Node identity + local accelerator inventory.  The TPU analog of
+        ``get_node_and_gpu_ids`` (ray_ddp.py:58-63): chip counts come from
+        the JAX runtime *if already initialized*, else env hints — the
+        driver uses this for topology bookkeeping only."""
+        info = {"ip": node_ip(), "pid": os.getpid()}
+        count = os.environ.get("RLT_NUM_LOCAL_DEVICES")
+        if count is not None:
+            info["num_local_devices"] = int(count)
+        return info
+
+    def ping(self) -> str:
+        return "pong"

@@ -1,0 +1,238 @@
+"""Built-in subprocess actor backend.
+
+Replaces Ray core for single-node use (and makes the framework runnable
+with zero orchestration dependencies): each actor is a subprocess
+connected to the driver over a unix socket, RPC is length-prefixed
+cloudpickle, and the worker→driver queue rides the same connection as
+unsolicited frames.  This supplies, in-repo, the runtime roles the
+reference outsources to Ray's C++ core (actor RPC, object transport,
+queue — SURVEY.md §2.2); an optional C++ shared-memory object store
+accelerates large-payload transport (native/, used when built).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import uuid
+from typing import Any, Optional
+
+import cloudpickle
+
+from ray_lightning_tpu.cluster.backend import (
+    ActorHandle,
+    ClusterBackend,
+    Future,
+)
+from ray_lightning_tpu.cluster.protocol import Connection
+
+
+class LocalObjectRef:
+    """Reference into the driver-side object store."""
+
+    __slots__ = ("object_id",)
+
+    def __init__(self, object_id: str):
+        self.object_id = object_id
+
+
+class LocalActorHandle(ActorHandle):
+    def __init__(self, backend: "LocalBackend", actor_id: str,
+                 proc: subprocess.Popen):
+        self.actor_id = actor_id
+        self._backend = backend
+        self._proc = proc
+        self._conn: Optional[Connection] = None
+        self._conn_ready = threading.Event()
+        self._pending: dict[str, Future] = {}
+        self._lock = threading.Lock()
+        self._dead = False
+        self._death_error: Optional[BaseException] = None
+
+    # -- wiring (called by backend accept loop) -------------------------
+
+    def _attach(self, conn: Connection) -> None:
+        self._conn = conn
+        self._conn_ready.set()
+        t = threading.Thread(target=self._reader, daemon=True,
+                             name=f"rlt-reader-{self.actor_id}")
+        t.start()
+
+    def _reader(self) -> None:
+        try:
+            while True:
+                msg = self._conn.recv()
+                kind = msg.get("type")
+                if kind == "result":
+                    with self._lock:
+                        fut = self._pending.pop(msg["call_id"], None)
+                    if fut is None:
+                        if not msg.get("ok", True):
+                            # e.g. constructor failure: no future is
+                            # awaiting this id — fail the actor with the
+                            # real remote traceback instead of dropping it.
+                            self._fail_pending(RemoteActorError(msg["error"]))
+                        continue
+                    if msg["ok"]:
+                        fut.set_result(msg["value"])
+                    else:
+                        fut.set_error(RemoteActorError(msg["error"]))
+                elif kind == "queue":
+                    self._backend._queue_push(msg["item"])
+        except (ConnectionError, OSError):
+            self._fail_pending(
+                RemoteActorError(
+                    f"actor {self.actor_id} died (connection lost); "
+                    f"returncode={self._proc.poll()}"))
+
+    def _fail_pending(self, err: BaseException) -> None:
+        self._dead = True
+        if self._death_error is None:
+            self._death_error = err  # keep the FIRST (root-cause) error
+        with self._lock:
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            fut.set_error(self._death_error)
+
+    # -- API -------------------------------------------------------------
+
+    def call(self, method: str, *args, **kwargs) -> Future:
+        fut = Future()
+        if self._dead:
+            fut.set_error(self._death_error or RemoteActorError(
+                f"actor {self.actor_id} is dead"))
+            return fut
+        if not self._conn_ready.wait(timeout=120):
+            fut.set_error(RemoteActorError(
+                f"actor {self.actor_id} never connected"))
+            return fut
+        call_id = uuid.uuid4().hex
+        with self._lock:
+            self._pending[call_id] = fut
+        try:
+            self._conn.send({"type": "call", "call_id": call_id,
+                             "method": method, "args": args,
+                             "kwargs": kwargs})
+        except (ConnectionError, OSError) as e:
+            self._fail_pending(RemoteActorError(str(e)))
+        return fut
+
+    def kill(self) -> None:
+        """Hard-stop the actor (``ray.kill(no_restart=True)`` analog,
+        ray_ddp.py:384)."""
+        self._dead = True
+        if self._conn is not None:
+            try:
+                self._conn.send({"type": "shutdown"})
+            except (ConnectionError, OSError):
+                pass
+        try:
+            self._proc.terminate()
+            self._proc.wait(timeout=5)
+        except (subprocess.TimeoutExpired, OSError):
+            self._proc.kill()
+
+
+class RemoteActorError(RuntimeError):
+    """An exception raised inside an actor, carried back with its remote
+    traceback text (what ``ray.get`` raising does for the reference,
+    util.py:61-63)."""
+
+
+class LocalBackend(ClusterBackend):
+    def __init__(self):
+        self._dir = tempfile.mkdtemp(prefix="rlt_cluster_")
+        self._sock_path = os.path.join(self._dir, "driver.sock")
+        import socket as _socket
+        self._listener = _socket.socket(_socket.AF_UNIX,
+                                        _socket.SOCK_STREAM)
+        self._listener.bind(self._sock_path)
+        self._listener.listen(64)
+        self._actors: dict[str, LocalActorHandle] = {}
+        self._objects: dict[str, bytes] = {}
+        self._queue: list[Any] = []
+        self._queue_lock = threading.Lock()
+        self._closed = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="rlt-accept")
+        self._accept_thread.start()
+
+    # -- accept/queue -----------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            conn = Connection(sock)
+            try:
+                hello = conn.recv()
+            except (ConnectionError, OSError):
+                continue
+            handle = self._actors.get(hello.get("actor_id"))
+            if handle is not None:
+                handle._attach(conn)
+
+    def _queue_push(self, item: Any) -> None:
+        with self._queue_lock:
+            self._queue.append(item)
+
+    def queue_get_nowait(self):
+        with self._queue_lock:
+            return self._queue.pop(0) if self._queue else None
+
+    # -- actors -----------------------------------------------------------
+
+    def create_actor(self, actor_cls: type, *args,
+                     env: Optional[dict[str, str]] = None,
+                     resources: Optional[dict[str, float]] = None,
+                     name: Optional[str] = None, **kwargs) -> ActorHandle:
+        actor_id = name or f"actor-{uuid.uuid4().hex[:8]}"
+        spec_path = os.path.join(self._dir, f"{actor_id}.spec")
+        with open(spec_path, "wb") as f:
+            f.write(cloudpickle.dumps((actor_cls, args, kwargs)))
+        child_env = {**os.environ, **(env or {})}
+        child_env["RLT_DRIVER_SOCKET"] = self._sock_path
+        child_env["RLT_ACTOR_ID"] = actor_id
+        child_env["RLT_ACTOR_SPEC"] = spec_path
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_lightning_tpu.cluster.worker_main"],
+            env=child_env, cwd=os.getcwd())
+        handle = LocalActorHandle(self, actor_id, proc)
+        self._actors[actor_id] = handle
+        return handle
+
+    # -- object store -----------------------------------------------------
+
+    def put(self, obj: Any) -> LocalObjectRef:
+        oid = uuid.uuid4().hex
+        self._objects[oid] = cloudpickle.dumps(obj)
+        return LocalObjectRef(oid)
+
+    def get(self, ref: Any) -> Any:
+        if isinstance(ref, LocalObjectRef):
+            return cloudpickle.loads(self._objects[ref.object_id])
+        if isinstance(ref, Future):
+            return ref.result()
+        return ref
+
+    def resolve_ref_payload(self, object_id: str) -> bytes:
+        return self._objects[object_id]
+
+    def available_resources(self) -> dict[str, float]:
+        return {"CPU": float(os.cpu_count() or 1)}
+
+    def shutdown(self) -> None:
+        self._closed = True
+        for handle in list(self._actors.values()):
+            handle.kill()
+        self._actors.clear()
+        self._objects.clear()
+        try:
+            self._listener.close()
+        except OSError:
+            pass

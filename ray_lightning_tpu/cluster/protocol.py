@@ -1,0 +1,84 @@
+"""Wire protocol for the built-in actor backend.
+
+Length-prefixed cloudpickle frames over a unix-domain socket — the
+transport under the built-in backend's actor RPC and the worker→driver
+queue stream (the roles Ray core's GCS/RPC + ``ray.util.queue.Queue``
+play for the reference, SURVEY.md §2.2).  Messages are dicts with a
+``type`` field:
+
+  driver→worker: {type: call, call_id, method, args, kwargs}
+                 {type: shutdown}
+  worker→driver: {type: hello, actor_id}
+                 {type: result, call_id, ok, value|error}
+                 {type: queue, item}         (unsolicited, session relay)
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any
+
+import cloudpickle
+
+_LEN = struct.Struct(">Q")
+MAX_FRAME = 1 << 36  # 64 GiB guard
+
+
+class Connection:
+    """Thread-safe framed connection over a stream socket."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._wlock = threading.Lock()
+        self._rlock = threading.Lock()
+
+    def send(self, msg: Any) -> None:
+        payload = cloudpickle.dumps(msg)
+        with self._wlock:
+            self._sock.sendall(_LEN.pack(len(payload)) + payload)
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = self._sock.recv(min(n, 1 << 20))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def recv(self) -> Any:
+        with self._rlock:
+            (length,) = _LEN.unpack(self._recv_exact(_LEN.size))
+            if length > MAX_FRAME:
+                raise ValueError(f"frame too large: {length}")
+            payload = self._recv_exact(length)
+        return cloudpickle.loads(payload)
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+def find_free_port(host: str = "") -> int:
+    """Bind port 0 and report what the OS picked (ray_ddp.py:31-35 analog;
+    used to allocate the PJRT coordinator port on the rank-0 node)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        return s.getsockname()[1]
+
+
+def node_ip() -> str:
+    """Best-effort IP of this node (RayExecutor.get_node_ip analog)."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"

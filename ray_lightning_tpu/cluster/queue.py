@@ -1,0 +1,35 @@
+"""Worker→driver queue endpoints.
+
+Reference equivalent: ``ray.util.queue.Queue`` created in
+``execution_loop`` (ray_ddp.py:335-338) and drained by
+``process_results`` (util.py:47-68).  Under the built-in backend the
+queue rides the actor's socket as unsolicited frames; under Ray it is a
+real ``ray.util.queue.Queue``.  Either way the worker-side object is a
+picklable proxy with ``put``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class WorkerQueueProxy:
+    """Picklable worker-side queue handle (built-in backend).
+
+    Inside an actor subprocess, ``put`` routes through the worker's
+    driver connection (worker_main.queue_send).
+    """
+
+    def put(self, item: Any) -> None:
+        from ray_lightning_tpu.cluster import worker_state
+        worker_state.queue_send(item)
+
+
+class RayQueueProxy:
+    """Adapter giving ray.util.queue.Queue the same ``put`` surface."""
+
+    def __init__(self, ray_queue):
+        self._q = ray_queue
+
+    def put(self, item: Any) -> None:
+        self._q.put(item)

@@ -1,0 +1,113 @@
+"""Real-Ray backend (used automatically when Ray is importable).
+
+Maps the backend interface onto Ray primitives exactly where the
+reference binds to them: ``@ray.remote`` actors with resource requests
+(ray_ddp.py:174-180), ``ray.put`` object transport (ray_ddp.py:331),
+``ray.util.queue.Queue`` relay (ray_ddp.py:335-338), ``ray.kill``
+teardown (ray_ddp.py:384).  TPU workers request ``{"TPU": chips}``
+custom resources instead of ``num_gpus`` — one actor per TPU host.
+
+This module is only imported when Ray is present (cluster/backend.py
+gates it), so the hard ``import ray`` here is safe.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import ray
+from ray.util.queue import Queue as RayQueue
+
+from ray_lightning_tpu.cluster.backend import (
+    ActorHandle,
+    ClusterBackend,
+    Future,
+)
+from ray_lightning_tpu.cluster.queue import RayQueueProxy
+
+
+class RayActorHandle(ActorHandle):
+    def __init__(self, actor):
+        self._actor = actor
+        self.actor_id = actor._actor_id.hex()
+
+    def call(self, method: str, *args, **kwargs) -> Future:
+        ref = getattr(self._actor, method).remote(*args, **kwargs)
+        fut = Future()
+
+        def _resolve():
+            try:
+                fut.set_result(ray.get(ref))
+            except BaseException as e:  # noqa: BLE001 - relayed to caller
+                fut.set_error(e)
+
+        import threading
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+    def kill(self) -> None:
+        ray.kill(self._actor, no_restart=True)
+
+
+class RayBackend(ClusterBackend):
+    supports_object_store = True
+
+    def __init__(self):
+        if not ray.is_initialized():
+            ray.init()
+        self._queue: Optional[RayQueue] = None
+
+    def _ensure_queue(self) -> RayQueue:
+        if self._queue is None:
+            # num_cpus=0 so the queue actor never competes for worker
+            # resources (ray_ddp.py:338 parity).
+            self._queue = RayQueue(actor_options={"num_cpus": 0})
+        return self._queue
+
+    def worker_queue_proxy(self) -> RayQueueProxy:
+        return RayQueueProxy(self._ensure_queue())
+
+    def create_actor(self, actor_cls: type, *args,
+                     env: Optional[dict[str, str]] = None,
+                     resources: Optional[dict[str, float]] = None,
+                     name: Optional[str] = None, **kwargs) -> ActorHandle:
+        resources = dict(resources or {})
+        num_cpus = resources.pop("CPU", 1)
+        num_gpus = resources.pop("GPU", 0)
+        options: dict[str, Any] = {
+            "num_cpus": num_cpus,
+            "num_gpus": num_gpus,
+        }
+        if resources:
+            options["resources"] = resources
+        if env:
+            options["runtime_env"] = {"env_vars": {
+                k: str(v) for k, v in env.items()}}
+        remote_cls = ray.remote(actor_cls)
+        actor = remote_cls.options(**options).remote(*args, **kwargs)
+        return RayActorHandle(actor)
+
+    def put(self, obj: Any):
+        return ray.put(obj)
+
+    def get(self, ref: Any) -> Any:
+        if isinstance(ref, Future):
+            return ref.result()
+        return ray.get(ref)
+
+    def queue_get_nowait(self):
+        if self._queue is None:
+            return None  # no queue was requested for this run
+        from ray.util.queue import Empty
+        try:
+            return self._queue.get_nowait()
+        except Empty:
+            return None
+
+    def available_resources(self) -> dict[str, float]:
+        return dict(ray.available_resources())
+
+    def shutdown(self) -> None:
+        if self._queue is not None:
+            self._queue.shutdown()
+            self._queue = None

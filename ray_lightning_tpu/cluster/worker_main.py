@@ -1,0 +1,66 @@
+"""Entry point of a built-in-backend actor subprocess.
+
+Connects back to the driver's unix socket, constructs the actor instance
+from its pickled spec, then serves calls sequentially on the main thread
+(JAX/libtpu want the main thread).  Unsolicited ``queue`` frames may be
+emitted mid-call through :func:`queue_send` — that is the transport under
+``session.put_queue`` (the reference's ray.util.queue relay,
+session.py:17-24 / util.py:47-52).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import traceback
+
+import cloudpickle
+
+from ray_lightning_tpu.cluster import worker_state
+from ray_lightning_tpu.cluster.protocol import Connection
+
+
+def main() -> int:
+    sock_path = os.environ["RLT_DRIVER_SOCKET"]
+    actor_id = os.environ["RLT_ACTOR_ID"]
+    spec_path = os.environ["RLT_ACTOR_SPEC"]
+
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(sock_path)
+    _conn = Connection(sock)
+    worker_state.set_conn(_conn)
+    _conn.send({"type": "hello", "actor_id": actor_id})
+
+    with open(spec_path, "rb") as f:
+        actor_cls, args, kwargs = cloudpickle.loads(f.read())
+    try:
+        actor = actor_cls(*args, **kwargs)
+    except BaseException:
+        _conn.send({"type": "result", "call_id": "__construct__",
+                    "ok": False, "error": traceback.format_exc()})
+        return 1
+
+    while True:
+        try:
+            msg = _conn.recv()
+        except (ConnectionError, OSError):
+            return 0
+        kind = msg.get("type")
+        if kind == "shutdown":
+            return 0
+        if kind != "call":
+            continue
+        call_id = msg["call_id"]
+        try:
+            method = getattr(actor, msg["method"])
+            value = method(*msg.get("args", ()), **msg.get("kwargs", {}))
+            _conn.send({"type": "result", "call_id": call_id, "ok": True,
+                        "value": value})
+        except BaseException:
+            _conn.send({"type": "result", "call_id": call_id, "ok": False,
+                        "error": traceback.format_exc()})
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,32 @@
+"""Per-worker-process connection state.
+
+Lives in its own module (not worker_main) because worker_main executes
+as ``__main__`` under ``python -m`` — a module-level global there would
+be invisible to code importing ``ray_lightning_tpu.cluster.worker_main``
+(two module objects).  Everything that needs the driver connection goes
+through here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_lightning_tpu.cluster.protocol import Connection
+
+_conn: Optional[Connection] = None
+
+
+def set_conn(conn: Optional[Connection]) -> None:
+    global _conn
+    _conn = conn
+
+
+def get_conn() -> Optional[Connection]:
+    return _conn
+
+
+def queue_send(item) -> None:
+    """Push an item onto the driver-side queue from inside an actor."""
+    if _conn is None:
+        raise RuntimeError("queue_send outside of a worker process")
+    _conn.send({"type": "queue", "item": item})

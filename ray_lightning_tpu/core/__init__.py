@@ -1,0 +1,16 @@
+from ray_lightning_tpu.core.module import LightningModule, StepContext
+from ray_lightning_tpu.core.trainer import Trainer
+from ray_lightning_tpu.core.callbacks import Callback, EarlyStopping, ModelCheckpoint
+from ray_lightning_tpu.core.data import DataLoader
+from ray_lightning_tpu.core.datamodule import LightningDataModule
+
+__all__ = [
+    "LightningModule",
+    "StepContext",
+    "Trainer",
+    "Callback",
+    "EarlyStopping",
+    "ModelCheckpoint",
+    "DataLoader",
+    "LightningDataModule",
+]

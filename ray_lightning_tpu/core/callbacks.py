@@ -1,0 +1,228 @@
+"""Callbacks: base class + ModelCheckpoint + EarlyStopping.
+
+The reference leans on PL's callback system (TuneReportCallback subclasses
+TuneCallback, tune.py:59-134; EarlyStopping exercised in
+tests/test_ddp.py:287-306; ModelCheckpoint best_model_path propagated at
+ray_ddp.py:378-380).  PL itself is not a dependency here, so the framework
+carries its own equivalents with the same semantics.  All callback hooks
+run host-side between compiled steps — they never appear inside traces.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+
+class Callback:
+    """Base callback; hooks mirror the PL names the reference relies on."""
+
+    def setup(self, trainer, module, stage: str) -> None: ...
+    def teardown(self, trainer, module, stage: str) -> None: ...
+    def on_fit_start(self, trainer, module) -> None: ...
+    def on_fit_end(self, trainer, module) -> None: ...
+    def on_sanity_check_start(self, trainer, module) -> None: ...
+    def on_sanity_check_end(self, trainer, module) -> None: ...
+    def on_train_start(self, trainer, module) -> None: ...
+    def on_train_end(self, trainer, module) -> None: ...
+    def on_train_epoch_start(self, trainer, module) -> None: ...
+    def on_train_epoch_end(self, trainer, module) -> None: ...
+    def on_train_batch_start(self, trainer, module, batch, batch_idx) -> None: ...
+    def on_train_batch_end(self, trainer, module, outputs, batch,
+                           batch_idx) -> None: ...
+    def on_validation_start(self, trainer, module) -> None: ...
+    def on_validation_end(self, trainer, module) -> None: ...
+    def on_validation_epoch_start(self, trainer, module) -> None: ...
+    def on_validation_epoch_end(self, trainer, module) -> None: ...
+    def on_validation_batch_end(self, trainer, module, outputs, batch,
+                                batch_idx) -> None: ...
+    def on_test_start(self, trainer, module) -> None: ...
+    def on_test_end(self, trainer, module) -> None: ...
+    def on_test_epoch_end(self, trainer, module) -> None: ...
+    def on_predict_start(self, trainer, module) -> None: ...
+    def on_predict_end(self, trainer, module) -> None: ...
+    def on_exception(self, trainer, module, err: BaseException) -> None: ...
+    def on_save_checkpoint(self, trainer, module, checkpoint: dict) -> None: ...
+    def on_load_checkpoint(self, trainer, module, checkpoint: dict) -> None: ...
+    def state_dict(self) -> dict:
+        return {}
+    def load_state_dict(self, state: dict) -> None: ...
+
+
+_MODE_OPS = {"min": (np.less, np.inf), "max": (np.greater, -np.inf)}
+
+
+class _Monitor:
+    """Shared monitored-metric machinery for checkpoint/early-stop."""
+
+    def __init__(self, monitor: Optional[str], mode: str, min_delta: float = 0.0):
+        if mode not in _MODE_OPS:
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        self.monitor = monitor
+        self.mode = mode
+        self.min_delta = abs(min_delta)
+        self.op, self.worst = _MODE_OPS[mode]
+        self.best = self.worst
+
+    def current(self, trainer) -> Optional[float]:
+        if self.monitor is None:
+            return None
+        val = trainer.callback_metrics.get(self.monitor)
+        return None if val is None else float(val)
+
+    def improved(self, value: float) -> bool:
+        delta = -self.min_delta if self.mode == "min" else self.min_delta
+        return bool(self.op(value, self.best + delta)) or self.best == self.worst
+
+
+class ModelCheckpoint(Callback):
+    """Save checkpoints, track the best one (``best_model_path`` parity —
+    the reference ships this path rank-0 → driver, ray_ddp.py:475-480)."""
+
+    def __init__(
+        self,
+        dirpath: Optional[str] = None,
+        filename: str = "epoch={epoch}-step={step}",
+        monitor: Optional[str] = None,
+        mode: str = "min",
+        save_top_k: int = 1,
+        save_last: bool = False,
+        every_n_epochs: int = 1,
+    ):
+        self.dirpath = dirpath
+        self.filename = filename
+        self.monitor = monitor
+        self.mode = mode
+        self.save_top_k = save_top_k
+        self.save_last = save_last
+        self.every_n_epochs = max(1, every_n_epochs)
+        self.best_model_path: str = ""
+        self.best_model_score: Optional[float] = None
+        self.last_model_path: str = ""
+        self._saved: list[tuple[float, str]] = []  # (score, path), best first
+        self._mon = _Monitor(monitor, mode)
+
+    def setup(self, trainer, module, stage: str) -> None:
+        if self.dirpath is None:
+            self.dirpath = os.path.join(trainer.default_root_dir, "checkpoints")
+
+    def _format_name(self, trainer) -> str:
+        name = self.filename.format(
+            epoch=trainer.current_epoch, step=trainer.global_step,
+            **{k: v for k, v in trainer.callback_metrics.items()
+               if isinstance(v, (int, float))})
+        return name + ".ckpt"
+
+    def _save(self, trainer, path: str) -> None:
+        # save_checkpoint is collective (all processes gather, rank 0
+        # writes) — every process must enter it, so no rank gate here.
+        trainer.save_checkpoint(path)
+
+    def on_validation_end(self, trainer, module) -> None:
+        if not trainer.sanity_checking:
+            self._maybe_save(trainer)
+
+    def on_train_epoch_end(self, trainer, module) -> None:
+        # Only save here when there was no validation this epoch.
+        if trainer.num_val_batches == 0:
+            self._maybe_save(trainer)
+
+    def _maybe_save(self, trainer) -> None:
+        if self.save_top_k == 0:
+            return
+        if (trainer.current_epoch + 1) % self.every_n_epochs != 0:
+            return
+        path = os.path.join(self.dirpath, self._format_name(trainer))
+        score = self._mon.current(trainer)
+        if self.monitor is None:
+            self._save(trainer, path)
+            self.best_model_path = path
+        else:
+            if score is None:
+                return
+            self._saved.append((score, path))
+            reverse = self.mode == "max"
+            self._saved.sort(key=lambda t: t[0], reverse=reverse)
+            if self.save_top_k > 0 and len(self._saved) > self.save_top_k:
+                _, evict = self._saved.pop()
+                if evict == path:
+                    self._record_last(trainer)
+                    return  # not in top-k; skip writing
+                if trainer.is_global_zero and os.path.exists(evict):
+                    os.remove(evict)
+            self._save(trainer, path)
+            self.best_model_score, self.best_model_path = self._saved[0]
+        self._record_last(trainer)
+
+    def _record_last(self, trainer) -> None:
+        if self.save_last:
+            last = os.path.join(self.dirpath, "last.ckpt")
+            self._save(trainer, last)
+            self.last_model_path = last
+
+    def state_dict(self) -> dict:
+        return {
+            "best_model_path": self.best_model_path,
+            "best_model_score": self.best_model_score,
+            "saved": list(self._saved),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.best_model_path = state.get("best_model_path", "")
+        self.best_model_score = state.get("best_model_score")
+        self._saved = [tuple(t) for t in state.get("saved", [])]
+
+
+class EarlyStopping(Callback):
+    """Stop training when a monitored metric stops improving
+    (exercised by the reference at tests/test_ddp.py:287-306)."""
+
+    def __init__(
+        self,
+        monitor: str = "val_loss",
+        min_delta: float = 0.0,
+        patience: int = 3,
+        mode: str = "min",
+        check_on_train_epoch_end: bool = False,
+    ):
+        self.monitor = monitor
+        self.patience = patience
+        self.wait_count = 0
+        self.stopped_epoch = 0
+        self.check_on_train_epoch_end = check_on_train_epoch_end
+        self._mon = _Monitor(monitor, mode, min_delta)
+
+    def _run_check(self, trainer) -> None:
+        value = self._mon.current(trainer)
+        if value is None:
+            return
+        if self._mon.improved(value):
+            self._mon.best = value
+            self.wait_count = 0
+        else:
+            self.wait_count += 1
+            if self.wait_count >= self.patience:
+                self.stopped_epoch = trainer.current_epoch
+                trainer.should_stop = True
+
+    def on_validation_end(self, trainer, module) -> None:
+        if not trainer.sanity_checking and not self.check_on_train_epoch_end:
+            self._run_check(trainer)
+
+    def on_train_epoch_end(self, trainer, module) -> None:
+        if self.check_on_train_epoch_end or trainer.num_val_batches == 0:
+            self._run_check(trainer)
+
+    def state_dict(self) -> dict:
+        return {
+            "best": self._mon.best,
+            "wait_count": self.wait_count,
+            "stopped_epoch": self.stopped_epoch,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self._mon.best = state.get("best", self._mon.worst)
+        self.wait_count = state.get("wait_count", 0)
+        self.stopped_epoch = state.get("stopped_epoch", 0)

@@ -1,0 +1,181 @@
+"""Data loading: numpy-first batches with per-process sharding.
+
+The reference relies on torch ``DataLoader`` + ``DistributedSampler``
+wired per stage by PL using ``distributed_sampler_kwargs``
+(ray_ddp.py:536-540).  On TPU the equivalent concern is *global-batch
+assembly*: each host process loads its shard of the global batch and the
+loop forms a global ``jax.Array`` over the mesh from process-local data.
+This loader therefore owns sharding directly (``shard(num_shards, index)``)
+instead of going through a sampler object.
+
+Datasets can be: a tuple/dict of arrays (fast vectorized path), any
+object with ``__len__`` + ``__getitem__`` (covers torch Datasets without
+importing torch), or an arbitrary iterable (no sharding/shuffle support).
+Batches are numpy pytrees; the training loop device-puts them with the
+strategy's batch sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Sequence
+
+import numpy as np
+
+
+def _to_numpy(x: Any) -> Any:
+    """Convert torch tensors / jax arrays / lists to numpy without importing
+    torch unconditionally."""
+    if isinstance(x, np.ndarray):
+        return x
+    if hasattr(x, "detach") and hasattr(x, "cpu"):  # torch.Tensor duck-type
+        return x.detach().cpu().numpy()
+    if hasattr(x, "__array__"):
+        return np.asarray(x)
+    return x
+
+
+def default_collate(samples: Sequence[Any]) -> Any:
+    """Stack a list of samples (arrays / tuples / dicts) into a batch."""
+    first = samples[0]
+    if isinstance(first, (tuple, list)):
+        return type(first)(
+            default_collate([s[i] for s in samples]) for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: default_collate([s[k] for s in samples]) for k in first}
+    arrs = [_to_numpy(s) for s in samples]
+    if np.isscalar(arrs[0]) or (isinstance(arrs[0], np.ndarray)
+                                and arrs[0].ndim == 0):
+        return np.asarray(arrs)
+    return np.stack(arrs)
+
+
+class ArrayDataset:
+    """Dataset over a pytree (tuple/dict) of equal-length arrays."""
+
+    def __init__(self, *arrays: Any, **named: Any):
+        if arrays and named:
+            raise ValueError("Pass either positional or named arrays.")
+        self._tree = named if named else (
+            arrays[0] if len(arrays) == 1 and isinstance(arrays[0], dict)
+            else tuple(arrays))
+        leaves = (list(self._tree.values())
+                  if isinstance(self._tree, dict) else list(self._tree))
+        if not leaves:
+            raise ValueError("Empty dataset.")
+        self._leaves = [_to_numpy(a) for a in leaves]
+        self._len = len(self._leaves[0])
+        for a in self._leaves:
+            if len(a) != self._len:
+                raise ValueError("All arrays must share the leading dim.")
+
+    def __len__(self) -> int:
+        return self._len
+
+    def _rebuild(self, leaves):
+        if isinstance(self._tree, dict):
+            return dict(zip(self._tree.keys(), leaves))
+        if isinstance(self._tree, tuple) and len(leaves) == 1:
+            return leaves[0]
+        return tuple(leaves)
+
+    def __getitem__(self, idx):
+        return self._rebuild([a[idx] for a in self._leaves])
+
+    def take(self, indices: np.ndarray):
+        """Vectorized gather of a batch of indices."""
+        return self._rebuild([a[indices] for a in self._leaves])
+
+
+class DataLoader:
+    """Minimal, shardable batch loader producing numpy pytrees."""
+
+    def __init__(
+        self,
+        dataset: Any,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        seed: int = 0,
+        collate_fn: Callable | None = None,
+        num_shards: int = 1,
+        shard_index: int = 0,
+    ):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.collate_fn = collate_fn or default_collate
+        self.num_shards = num_shards
+        self.shard_index = shard_index
+        self._epoch = 0
+        if not hasattr(dataset, "__len__"):
+            if shuffle or num_shards > 1:
+                raise ValueError(
+                    "Iterable datasets support neither shuffle nor sharding.")
+
+    # -- distributed-sampler analog ---------------------------------------
+
+    def shard(self, num_shards: int, shard_index: int) -> "DataLoader":
+        """Return a copy of this loader restricted to one process's shard
+        (``DistributedSampler`` analog, ray_ddp.py:536-540)."""
+        clone = DataLoader(
+            self.dataset,
+            batch_size=self.batch_size,
+            shuffle=self.shuffle,
+            drop_last=self.drop_last,
+            seed=self.seed,
+            collate_fn=self.collate_fn,
+            num_shards=num_shards,
+            shard_index=shard_index,
+        )
+        clone._epoch = self._epoch
+        return clone
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reshuffle deterministically per epoch (DistributedSampler parity)."""
+        self._epoch = int(epoch)
+
+    # -- iteration ---------------------------------------------------------
+
+    def _indices(self) -> np.ndarray:
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            idx = rng.permutation(n)
+        else:
+            idx = np.arange(n)
+        if self.num_shards > 1:
+            # Pad so every shard sees the same number of samples (matching
+            # DistributedSampler's wrap-around), then stride.
+            pad = (-len(idx)) % self.num_shards
+            if pad:
+                idx = np.concatenate([idx, idx[:pad]])
+            idx = idx[self.shard_index::self.num_shards]
+        return idx
+
+    def __len__(self) -> int:
+        if not hasattr(self.dataset, "__len__"):
+            raise TypeError("Iterable dataset has no length.")
+        n = len(self._indices())
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self) -> Iterator[Any]:
+        if not hasattr(self.dataset, "__len__"):
+            yield from self.dataset
+            return
+        idx = self._indices()
+        n_full = len(idx) // self.batch_size
+        end = n_full * self.batch_size if self.drop_last else len(idx)
+        fast = isinstance(self.dataset, ArrayDataset)
+        for start in range(0, end, self.batch_size):
+            batch_idx = idx[start:start + self.batch_size]
+            if len(batch_idx) == 0:
+                break
+            if fast:
+                yield self.dataset.take(batch_idx)
+            else:
+                yield self.collate_fn([self.dataset[int(i)]
+                                       for i in batch_idx])

@@ -1,0 +1,48 @@
+"""LightningDataModule analog (used by the reference's Tune example,
+examples/ray_ddp_tune.py with pl_bolts MNISTDataModule)."""
+
+from __future__ import annotations
+
+
+class LightningDataModule:
+    """Groups dataloaders + data lifecycle hooks, separable from the model."""
+
+    def __init__(self):
+        self.trainer = None
+        self._prepared = False
+        self._setup_stages: set[str] = set()
+
+    def prepare_data(self) -> None:
+        """One-time, per-node data materialization (download etc.)."""
+
+    def setup(self, stage: str) -> None:
+        """Per-process setup (splits, transforms)."""
+
+    def train_dataloader(self):
+        return None
+
+    def val_dataloader(self):
+        return None
+
+    def test_dataloader(self):
+        return None
+
+    def predict_dataloader(self):
+        return None
+
+    # -- lifecycle bookkeeping (idempotent, like PL) -----------------------
+
+    def _call_prepare_data(self) -> None:
+        if not self._prepared:
+            self.prepare_data()
+            self._prepared = True
+
+    def _call_setup(self, stage: str) -> None:
+        if stage not in self._setup_stages:
+            self.setup(stage)
+            self._setup_stages.add(stage)
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["trainer"] = None
+        return state

@@ -1,0 +1,291 @@
+"""JAX-native LightningModule.
+
+The reference consumes ``pl.LightningModule`` unchanged because torch
+modules are stateful objects that can be pickled to workers and mutated
+in-place (ray_ddp.py:331, :439-443).  On TPU the training step must be a
+*pure function* XLA can trace once and compile, so this module re-designs
+the contract rather than porting it:
+
+- the user's ``training_step`` / ``validation_step`` receive a
+  :class:`StepContext` — a per-trace facade that carries params, mutable
+  model collections (e.g. flax batch_stats), and a PRNG stream, and
+  collects ``ctx.log(...)`` metrics functionally.  Inside a trace, all
+  "mutation" is local to the context object and returned to the loop as
+  values; there is no hidden module state.
+- the module object itself holds only *static* things: the flax model
+  definition, hyperparameters, dataloaders, host-side hooks.  It pickles
+  cheaply driver→worker (params are initialized worker-side, sharded by
+  the strategy — live device arrays never cross the boundary; cf. the
+  "pickling across the boundary" hazard, SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import copy
+import inspect
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+
+
+class StepContext:
+    """Functional stand-in for a stateful module inside a traced step.
+
+    Exposes:
+      - ``ctx.apply(*args, **kwargs)`` — run the flax model with the right
+        variable collections; under training, mutable collections (e.g.
+        ``batch_stats``) are updated into the context and threaded back to
+        the train state by the loop.
+      - ``ctx.make_rng()`` — split a fresh PRNG key (dropout etc.).
+      - ``ctx.log(name, value)`` — record a scalar metric; collected and
+        returned from the compiled step, then surfaced in
+        ``trainer.callback_metrics`` (reference metric flow:
+        ray_ddp.py:488-492, :366-370).
+    """
+
+    __slots__ = (
+        "module",
+        "params",
+        "model_state",
+        "training",
+        "_rng",
+        "_logged",
+    )
+
+    def __init__(
+        self,
+        module: "LightningModule",
+        params: Any,
+        model_state: Any,
+        rng: jax.Array | None,
+        training: bool,
+    ):
+        self.module = module
+        self.params = params
+        self.model_state = dict(model_state) if model_state else {}
+        self.training = training
+        self._rng = rng
+        self._logged: dict[str, jax.Array] = {}
+
+    # -- model application -------------------------------------------------
+
+    @property
+    def model(self):
+        return self.module.model
+
+    def make_rng(self) -> jax.Array:
+        if self._rng is None:
+            raise RuntimeError("No PRNG key available in this step context.")
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def apply(self, *args, method=None, rngs=None, **kwargs):
+        """Apply the flax model functionally.
+
+        Mutable collections are updated in the context during training so
+        consecutive ``apply`` calls in one step see each other's updates,
+        and the loop persists them into the train state.
+        """
+        if self.model is None:
+            raise RuntimeError(
+                "ctx.apply() requires configure_model() to return a flax "
+                "module; otherwise compute params directly in your step.")
+        variables = {"params": self.params, **self.model_state}
+        if rngs is None and self.training and self._rng is not None:
+            rngs = {"dropout": self.make_rng()}
+        mutable = list(self.model_state.keys()) if self.training else False
+        if mutable:
+            out, updated = self.model.apply(
+                variables, *args, method=method, rngs=rngs, mutable=mutable,
+                **kwargs)
+            self.model_state = dict(updated)
+            return out
+        return self.model.apply(
+            variables, *args, method=method, rngs=rngs, **kwargs)
+
+    # -- metric logging ----------------------------------------------------
+
+    def log(self, name: str, value, **_ignored) -> None:
+        self._logged[name] = jnp.asarray(value, dtype=jnp.float32)
+
+    def log_dict(self, metrics: Mapping[str, Any], **_ignored) -> None:
+        for k, v in metrics.items():
+            self.log(k, v)
+
+    @property
+    def logged(self) -> dict[str, jax.Array]:
+        return dict(self._logged)
+
+
+class _HParams(dict):
+    """Attribute-accessible hyperparameter dict (PL ``hparams`` analog)."""
+
+    def __getattr__(self, item):
+        try:
+            return self[item]
+        except KeyError as e:
+            raise AttributeError(item) from e
+
+    def __setattr__(self, key, value):
+        self[key] = value
+
+
+class LightningModule:
+    """Base class for user models (``pl.LightningModule`` analog).
+
+    Subclasses implement (all step fns are pure and traced under jit):
+
+    - ``configure_model() -> flax.linen.Module`` (or ``None`` and work with
+      raw params via a custom ``init_params``)
+    - ``configure_optimizers() -> optax.GradientTransformation``
+    - ``training_step(ctx, batch) -> loss``  (log metrics via ``ctx.log``)
+    - ``validation_step(ctx, batch) -> None | loss``
+    - ``test_step(ctx, batch)``, ``predict_step(ctx, batch) -> outputs``
+    - dataloaders: ``train_dataloader`` / ``val_dataloader`` /
+      ``test_dataloader`` / ``predict_dataloader``
+    """
+
+    def __init__(self):
+        self.trainer = None
+        self.model = None
+        self._hparams = _HParams()
+        self._example_batch = None
+
+    # -- persistence across the driver→worker boundary ---------------------
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["trainer"] = None  # trainer holds live handles; re-bound remotely
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+    # -- hyperparameters ---------------------------------------------------
+
+    def save_hyperparameters(self, *args, **kwargs) -> None:
+        """Record the calling constructor's arguments into ``self.hparams``."""
+        frame = inspect.currentframe().f_back
+        local_vars = frame.f_locals
+        if args or kwargs:
+            for a in args:
+                if isinstance(a, dict):
+                    self._hparams.update(a)
+                elif isinstance(a, str) and a in local_vars:
+                    self._hparams[a] = local_vars[a]
+            self._hparams.update(kwargs)
+            return
+        init = type(self).__init__
+        sig = inspect.signature(init)
+        for name in sig.parameters:
+            if name in ("self", "args", "kwargs"):
+                continue
+            if name in local_vars:
+                self._hparams[name] = copy.deepcopy(local_vars[name])
+
+    @property
+    def hparams(self) -> _HParams:
+        return self._hparams
+
+    # -- model / optimizer configuration -----------------------------------
+
+    def configure_model(self):
+        """Return the flax module (or None for raw-param workflows)."""
+        return None
+
+    def configure_optimizers(self):
+        raise NotImplementedError
+
+    def setup_model(self) -> None:
+        """Materialize ``self.model`` (idempotent; called on each process)."""
+        if self.model is None:
+            self.model = self.configure_model()
+
+    def init_params(self, rng: jax.Array, batch: Any):
+        """Initialize model variables from an example batch.
+
+        Default: call ``model.init(rng, x)`` where ``x`` is ``batch[0]``
+        for (input, target) tuples else the batch itself.  Override for
+        models whose ``__call__`` takes a different signature.  Returns the
+        full flax variables dict (``{'params': ..., possibly others}``).
+        """
+        self.setup_model()
+        if self.model is None:
+            raise NotImplementedError(
+                "Provide configure_model() or override init_params().")
+        x = batch[0] if isinstance(batch, (tuple, list)) else batch
+        return self.model.init(rng, x)
+
+    # -- steps (pure; traced) ----------------------------------------------
+
+    def training_step(self, ctx: StepContext, batch) -> jax.Array:
+        raise NotImplementedError
+
+    def validation_step(self, ctx: StepContext, batch):
+        return None
+
+    def test_step(self, ctx: StepContext, batch):
+        return self.validation_step(ctx, batch)
+
+    def predict_step(self, ctx: StepContext, batch):
+        if self.model is None:
+            raise NotImplementedError
+        x = batch[0] if isinstance(batch, (tuple, list)) else batch
+        return ctx.apply(x)
+
+    # -- data --------------------------------------------------------------
+
+    def prepare_data(self) -> None:
+        """Download / materialize data once per node (host-side hook)."""
+
+    def setup(self, stage: str) -> None:
+        """Per-process setup before dataloaders are requested."""
+
+    def train_dataloader(self):
+        return None
+
+    def val_dataloader(self):
+        return None
+
+    def test_dataloader(self):
+        return None
+
+    def predict_dataloader(self):
+        return None
+
+    # -- host-side hooks (never traced) ------------------------------------
+
+    def on_fit_start(self) -> None: ...
+    def on_fit_end(self) -> None: ...
+    def on_train_start(self) -> None: ...
+    def on_train_end(self) -> None: ...
+    def on_train_epoch_start(self) -> None: ...
+    def on_train_epoch_end(self) -> None: ...
+    def on_validation_epoch_start(self) -> None: ...
+    def on_validation_epoch_end(self) -> None: ...
+    def on_save_checkpoint(self, checkpoint: dict) -> None: ...
+    def on_load_checkpoint(self, checkpoint: dict) -> None: ...
+
+    # -- trainer-delegated conveniences ------------------------------------
+
+    @property
+    def global_rank(self) -> int:
+        return self.trainer.global_rank if self.trainer is not None else 0
+
+    @property
+    def local_rank(self) -> int:
+        return self.trainer.local_rank if self.trainer is not None else 0
+
+    @property
+    def current_epoch(self) -> int:
+        return self.trainer.current_epoch if self.trainer is not None else 0
+
+    @property
+    def global_step(self) -> int:
+        return self.trainer.global_step if self.trainer is not None else 0
+
+    def log(self, name: str, value, **kwargs) -> None:
+        """Host-side logging from hooks (traced steps use ``ctx.log``)."""
+        if self.trainer is not None:
+            self.trainer._log_host_metric(name, value)

@@ -1,0 +1,28 @@
+"""Train state pytree.
+
+Replaces the implicit (module, optimizer, grad-scaler) object state of the
+torch stack with one explicit pytree that flows through the compiled step
+— the unit that strategies shard, checkpoints serialize, and the
+rank-0→driver state stream round-trips (util.py:71-90 analog).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    model_state: Any          # non-trainable collections (batch_stats, ...)
+    opt_state: Any
+    rng: jax.Array
+
+    @classmethod
+    def create(cls, params, model_state, opt_state, rng):
+        import jax.numpy as jnp
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   model_state=model_state, opt_state=opt_state, rng=rng)

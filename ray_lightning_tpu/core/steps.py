@@ -1,0 +1,142 @@
+"""Compiled step builders.
+
+Each stage (train / eval / predict / init) is one pure function, jitted
+once with the strategy's shardings.  This replaces the reference's hot
+loop — PL's ``trainer.run_stage()`` driving torch autograd + DDP hooks
+inside each worker (ray_ddp.py:472) — with XLA-compiled SPMD programs:
+gradient sync is not an op we call, it is a sharding consequence the
+compiler lowers to ICI collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_lightning_tpu.core.module import StepContext
+from ray_lightning_tpu.core.state import TrainState
+
+
+def build_init_fn(module, tx) -> Callable:
+    """(rng, example_batch) -> TrainState with freshly initialized params."""
+
+    def init_fn(rng, batch):
+        init_rng, state_rng = jax.random.split(rng)
+        variables = dict(module.init_params(init_rng, batch))
+        params = variables.pop("params")
+        model_state = variables
+        opt_state = tx.init(params)
+        return TrainState.create(params, model_state, opt_state, state_rng)
+
+    return init_fn
+
+
+def _split_loss(out) -> tuple[jax.Array, dict]:
+    if isinstance(out, dict):
+        if "loss" not in out:
+            raise ValueError("training_step dict output must contain 'loss'")
+        extra = {k: v for k, v in out.items() if k != "loss"}
+        return out["loss"], extra
+    return out, {}
+
+
+def build_train_step(module, tx,
+                     accumulate_grad_batches: int = 1) -> Callable:
+    """(state, batch) -> (state', metrics).
+
+    With ``accumulate_grad_batches=k`` the batch's leading dim is split
+    into k microbatches folded with ``lax.scan`` (static trip count —
+    XLA-friendly control flow, no data-dependent Python), gradients are
+    averaged, and one optimizer step is applied.
+    """
+
+    def grads_of(params, model_state, rng, batch):
+        def loss_fn(p):
+            ctx = StepContext(module, p, model_state, rng, training=True)
+            loss, extra = _split_loss(module.training_step(ctx, batch))
+            return loss, (ctx.model_state, {**ctx.logged, **extra})
+        (loss, (new_ms, logged)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        return loss, new_ms, logged, grads
+
+    def step_fn(state: TrainState, batch: Any):
+        new_rng, step_rng = jax.random.split(state.rng)
+        step_rng = jax.random.fold_in(step_rng, state.step)
+
+        if accumulate_grad_batches <= 1:
+            loss, new_ms, logged, grads = grads_of(
+                state.params, state.model_state, step_rng, batch)
+        else:
+            k = accumulate_grad_batches
+
+            def to_micro(x):
+                if getattr(x, "ndim", 0) == 0:
+                    return x
+                if x.shape[0] % k:
+                    raise ValueError(
+                        f"Batch size {x.shape[0]} must be divisible by "
+                        f"accumulate_grad_batches={k}")
+                return x.reshape((k, x.shape[0] // k) + x.shape[1:])
+
+            micro = jax.tree_util.tree_map(to_micro, batch)
+
+            def body(carry, mb):
+                ms, acc = carry
+                rng_i = jax.random.fold_in(step_rng, acc["_i"])
+                loss, ms, logged, grads = grads_of(state.params, ms, rng_i, mb)
+                acc_g = jax.tree_util.tree_map(jnp.add, acc["g"], grads)
+                return (ms, {"g": acc_g, "_i": acc["_i"] + 1}), (loss, logged)
+
+            zero_g = jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p), state.params)
+            (new_ms, acc), (losses, logged_seq) = jax.lax.scan(
+                body, (state.model_state, {"g": zero_g, "_i": jnp.zeros(
+                    (), jnp.int32)}), micro)
+            grads = jax.tree_util.tree_map(lambda g: g / k, acc["g"])
+            loss = losses.mean()
+            logged = jax.tree_util.tree_map(lambda x: x.mean(), logged_seq)
+
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, **logged}
+        new_state = state.replace(
+            step=state.step + 1, params=new_params, model_state=new_ms,
+            opt_state=new_opt, rng=new_rng)
+        return new_state, metrics
+
+    return step_fn
+
+
+def build_eval_step(module, stage: str) -> Callable:
+    """(state, batch) -> logged metrics dict (pure, no state mutation)."""
+    step = {"validate": module.validation_step,
+            "test": module.test_step}[stage]
+
+    def step_fn(state: TrainState, batch: Any):
+        ctx = StepContext(module, state.params, state.model_state,
+                          rng=None, training=False)
+        out = step(ctx, batch)
+        logged = ctx.logged
+        if out is not None and not isinstance(out, dict) and not logged:
+            # A bare returned scalar with nothing logged: surface it.
+            logged = {"val_loss" if stage == "validate" else "test_loss":
+                      jnp.asarray(out, jnp.float32)}
+        elif isinstance(out, dict):
+            logged = {**logged,
+                      **{k: jnp.asarray(v, jnp.float32)
+                         for k, v in out.items()}}
+        return logged
+
+    return step_fn
+
+
+def build_predict_step(module) -> Callable:
+    def step_fn(state: TrainState, batch: Any):
+        ctx = StepContext(module, state.params, state.model_state,
+                          rng=None, training=False)
+        return module.predict_step(ctx, batch)
+
+    return step_fn

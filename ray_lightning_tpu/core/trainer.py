@@ -1,0 +1,735 @@
+"""The Trainer: ``pl.Trainer`` capability analog, re-designed TPU-first.
+
+Structure of a run (compare SURVEY.md §3.1 call stack):
+
+  driver:  Trainer.fit(module)
+    └─ plugin.run(...)            — LocalPlugin executes in-process;
+                                    RayXlaPlugin ships (trainer, module,
+                                    datamodule) to actor workers and
+                                    round-trips results (plugins/)
+  worker:  trainer._run_stage(...)
+    ├─ strategy.build_mesh()      — Mesh over all chips of all hosts
+    ├─ jit(init_fn, out_shardings=state_shardings)   — params born sharded
+    ├─ jit(train_step, donate_argnums=0)             — ONE compiled SPMD
+    │                                                   program; gradient
+    │                                                   sync is a sharding
+    │                                                   consequence
+    └─ host loop: batches → global arrays → compiled step; callbacks and
+       checkpointing run host-side between steps.
+
+The host loop never inspects device values except at logging/validation
+boundaries (JAX async dispatch keeps the device pipeline full — the
+explicit host-transfer-point discipline flagged in SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import time
+from typing import Any, Optional
+
+import fsspec
+import jax
+import numpy as np
+import optax
+from flax import serialization
+
+from ray_lightning_tpu.core.callbacks import Callback, ModelCheckpoint
+from ray_lightning_tpu.core.state import TrainState
+from ray_lightning_tpu.core.steps import (
+    build_eval_step,
+    build_init_fn,
+    build_predict_step,
+    build_train_step,
+)
+from ray_lightning_tpu.parallel.gather import fetch_tree
+from ray_lightning_tpu.parallel.strategy import resolve_strategy
+from ray_lightning_tpu.utils.seed import reset_seed, seed_everything
+
+_log = logging.getLogger(__name__)
+
+_RUNTIME_FIELDS = (
+    "state", "_mesh", "_train_step", "_eval_steps", "_predict_step",
+    "_state_shardings", "_abstract_state", "_tx", "_init_fn", "_init_rng",
+)
+
+
+class Trainer:
+    """Drives fit / validate / test / predict for a LightningModule."""
+
+    def __init__(
+        self,
+        max_epochs: Optional[int] = None,
+        max_steps: int = -1,
+        callbacks: Optional[list[Callback]] = None,
+        plugins: Optional[list] = None,
+        strategy: Any = None,
+        default_root_dir: Optional[str] = None,
+        enable_checkpointing: bool = True,
+        limit_train_batches: Optional[int] = None,
+        limit_val_batches: Optional[int] = None,
+        limit_test_batches: Optional[int] = None,
+        limit_predict_batches: Optional[int] = None,
+        check_val_every_n_epoch: int = 1,
+        val_check_interval: Optional[int] = None,
+        log_every_n_steps: int = 50,
+        num_sanity_val_steps: int = 2,
+        accumulate_grad_batches: int = 1,
+        gradient_clip_val: Optional[float] = None,
+        precision: str = "32",
+        seed: Optional[int] = None,
+        resume_from_checkpoint: Optional[str] = None,
+        use_distributed_sampler: bool = True,
+        enable_progress_bar: bool = False,   # accepted for API parity
+        logger: Any = True,                  # accepted for API parity
+    ):
+        if max_epochs is None and (max_steps is None or max_steps < 0):
+            max_epochs = 1000
+        self.max_epochs = max_epochs
+        self.max_steps = max_steps if max_steps is not None else -1
+        self.callbacks: list[Callback] = list(callbacks or [])
+        self.default_root_dir = default_root_dir or os.path.join(
+            os.getcwd(), "rlt_logs")
+        self.enable_checkpointing = enable_checkpointing
+        self.limit_train_batches = limit_train_batches
+        self.limit_val_batches = limit_val_batches
+        self.limit_test_batches = limit_test_batches
+        self.limit_predict_batches = limit_predict_batches
+        self.check_val_every_n_epoch = max(1, check_val_every_n_epoch)
+        self.val_check_interval = val_check_interval
+        self.log_every_n_steps = max(1, log_every_n_steps)
+        self.num_sanity_val_steps = num_sanity_val_steps
+        self.accumulate_grad_batches = max(1, accumulate_grad_batches)
+        self.gradient_clip_val = gradient_clip_val
+        self.precision = str(precision)
+        self.seed = seed
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.use_distributed_sampler = use_distributed_sampler
+
+        # execution plugin (LocalPlugin unless a distributed one is given)
+        from ray_lightning_tpu.plugins.base import LocalPlugin
+        dist = [p for p in (plugins or []) if hasattr(p, "run")]
+        if len(dist) > 1:
+            raise ValueError("At most one execution plugin is supported.")
+        self.plugin = dist[0] if dist else LocalPlugin()
+        if strategy is not None:
+            # explicit Trainer(strategy=...) overrides the plugin default
+            self.plugin.strategy = resolve_strategy(strategy)
+
+        if enable_checkpointing and not any(
+                isinstance(c, ModelCheckpoint) for c in self.callbacks):
+            self.callbacks.append(ModelCheckpoint())
+
+        # run state
+        self.lightning_module = None
+        self.datamodule = None
+        self.current_epoch = 0
+        self.global_step = 0
+        self.should_stop = False
+        self.sanity_checking = False
+        self.num_val_batches = 0
+        self.callback_metrics: dict[str, float] = {}
+        self.logged_metrics: dict[str, float] = {}
+        self.state: Optional[TrainState] = None
+        self._world = {"world_size": 1, "global_rank": 0, "local_rank": 0,
+                       "node_rank": 0}
+        self._mesh = None
+        self._epoch_metric_acc: dict[str, list] = {}
+        self._warned_skip = False
+        self._stage = None
+
+    # ------------------------------------------------------------------
+    # pickling across the driver→worker boundary (ray_ddp.py:164-172
+    # analog: drop live handles / compiled functions / device arrays)
+    # ------------------------------------------------------------------
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        for f in _RUNTIME_FIELDS:
+            state[f] = None
+        state["lightning_module"] = None
+        state["datamodule"] = None
+        return state
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def fit(self, module, datamodule=None, ckpt_path: Optional[str] = None):
+        ckpt_path = ckpt_path or self.resume_from_checkpoint
+        return self.plugin.run(self, module, datamodule, "fit", ckpt_path)
+
+    def validate(self, module, datamodule=None,
+                 ckpt_path: Optional[str] = None):
+        return self.plugin.run(self, module, datamodule, "validate", ckpt_path)
+
+    def test(self, module, datamodule=None, ckpt_path: Optional[str] = None):
+        return self.plugin.run(self, module, datamodule, "test", ckpt_path)
+
+    def predict(self, module, datamodule=None,
+                ckpt_path: Optional[str] = None):
+        return self.plugin.run(self, module, datamodule, "predict", ckpt_path)
+
+    # -- world info -----------------------------------------------------
+
+    @property
+    def world_size(self) -> int:
+        return self._world["world_size"]
+
+    @property
+    def global_rank(self) -> int:
+        return self._world["global_rank"]
+
+    @property
+    def local_rank(self) -> int:
+        return self._world["local_rank"]
+
+    @property
+    def node_rank(self) -> int:
+        return self._world["node_rank"]
+
+    @property
+    def is_global_zero(self) -> bool:
+        return self.global_rank == 0
+
+    @property
+    def strategy(self):
+        return self.plugin.strategy
+
+    @property
+    def checkpoint_callback(self) -> Optional[ModelCheckpoint]:
+        for c in self.callbacks:
+            if isinstance(c, ModelCheckpoint):
+                return c
+        return None
+
+    @property
+    def early_stopping_callback(self):
+        from ray_lightning_tpu.core.callbacks import EarlyStopping
+        for c in self.callbacks:
+            if isinstance(c, EarlyStopping):
+                return c
+        return None
+
+    # ------------------------------------------------------------------
+    # stage execution (runs in-process locally, or inside each worker)
+    # ------------------------------------------------------------------
+
+    def _run_stage(self, module, datamodule, stage: str,
+                   ckpt_path: Optional[str] = None):
+        self._stage = stage
+        self.lightning_module = module
+        module.trainer = self
+        self.datamodule = datamodule
+        if datamodule is not None:
+            datamodule.trainer = self
+
+        if self.seed is not None:
+            seed_everything(self.seed)
+        else:
+            reset_seed()
+
+        self._world = {
+            "world_size": jax.process_count(),
+            "global_rank": jax.process_index(),
+            "local_rank": 0,
+            "node_rank": jax.process_index(),
+        }
+
+        # data lifecycle (reference: prepare_data per worker, ray_ddp.py:446)
+        if datamodule is not None:
+            datamodule._call_prepare_data()
+            datamodule._call_setup(stage)
+        module.prepare_data()
+        module.setup(stage)
+        module.setup_model()
+
+        strategy = self.plugin.strategy
+        if strategy is None:
+            strategy = resolve_strategy(None)
+            self.plugin.strategy = strategy
+
+        loaders = self._build_loaders(stage)
+        first_loader = loaders.get(
+            {"fit": "train", "validate": "val", "test": "test",
+             "predict": "predict"}[stage])
+        if first_loader is None:
+            raise ValueError(f"No dataloader available for stage {stage!r}")
+
+        example_batch, replacement = _peek_first_batch(first_loader)
+        if replacement is not first_loader:
+            key = {"fit": "train", "validate": "val", "test": "test",
+                   "predict": "predict"}[stage]
+            loaders[key] = replacement
+        leaves = jax.tree_util.tree_leaves(example_batch)
+        batch_hint = (leaves[0].shape[0] * jax.process_count()
+                      if leaves and getattr(leaves[0], "ndim", 0) > 0
+                      else None)
+        self._mesh = strategy.build_mesh(self.plugin.local_devices(),
+                                         batch_hint=batch_hint)
+        self._build_compiled(module, example_batch, strategy)
+        self._init_state(module, example_batch, strategy, ckpt_path)
+
+        for cb in self.callbacks:
+            cb.setup(self, module, stage)
+        try:
+            if stage == "fit":
+                result = self._fit_loop(module, loaders)
+            elif stage in ("validate", "test"):
+                result = self._run_eval_stage(module, stage, loaders)
+            else:
+                result = self._predict_loop(module, loaders)
+        except BaseException as e:
+            for cb in self.callbacks:
+                cb.on_exception(self, module, e)
+            raise
+        finally:
+            for cb in self.callbacks:
+                cb.teardown(self, module, stage)
+        return result
+
+    # -- data -----------------------------------------------------------
+
+    def _get_loader(self, name: str):
+        src = None
+        if self.datamodule is not None:
+            src = getattr(self.datamodule, f"{name}_dataloader")()
+        if src is None:
+            src = getattr(self.lightning_module, f"{name}_dataloader")()
+        if src is not None and self.use_distributed_sampler \
+                and self.world_size > 1 and hasattr(src, "shard"):
+            src = src.shard(self.world_size, self.global_rank)
+        return src
+
+    def _build_loaders(self, stage: str) -> dict:
+        if stage == "fit":
+            return {"train": self._get_loader("train"),
+                    "val": self._get_loader("val")}
+        if stage == "validate":
+            return {"val": self._get_loader("val")}
+        if stage == "test":
+            return {"test": self._get_loader("test")}
+        return {"predict": self._get_loader("predict")}
+
+    # -- compilation -----------------------------------------------------
+
+    def _configure_tx(self, module):
+        tx = module.configure_optimizers()
+        if isinstance(tx, dict):
+            tx = tx["optimizer"]
+        if self.gradient_clip_val:
+            tx = optax.chain(
+                optax.clip_by_global_norm(self.gradient_clip_val), tx)
+        return tx
+
+    def _build_compiled(self, module, example_batch, strategy):
+        self._tx = self._configure_tx(module)
+        self._init_fn = build_init_fn(module, self._tx)
+        rng = jax.random.PRNGKey(
+            int(os.environ.get("RLT_GLOBAL_SEED", "0")) if self.seed is None
+            else self.seed)
+        self._init_rng = rng
+        abstract = jax.eval_shape(self._init_fn, rng, example_batch)
+        self._abstract_state = abstract
+        shardings = strategy.state_shardings(self._mesh, abstract)
+        self._state_shardings = shardings
+        self._train_step = jax.jit(
+            build_train_step(module, self._tx, self.accumulate_grad_batches),
+            donate_argnums=0, out_shardings=(shardings, None))
+        self._eval_steps = {
+            s: jax.jit(build_eval_step(module, s))
+            for s in ("validate", "test")}
+        self._predict_step = jax.jit(build_predict_step(module))
+
+    def _put_batch(self, batch, strategy):
+        """Host numpy batch → global device arrays with the strategy's
+        sharding.  Multi-process: each process contributes its local shard
+        (``make_array_from_process_local_data``) — the TPU-native
+        equivalent of DistributedSampler feeding per-rank DDP replicas."""
+        shardings = strategy.batch_shardings(self._mesh, batch)
+        if jax.process_count() > 1:
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.make_array_from_process_local_data(
+                    s, np.asarray(x)),
+                batch, shardings)
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(np.asarray(x), s), batch, shardings)
+
+    def _batch_ok(self, batch, strategy) -> bool:
+        """Leading dim must divide over data shards (XLA static shapes)."""
+        dp = strategy.data_parallel_size(self._mesh) // max(
+            1, jax.process_count())
+        leaves = jax.tree_util.tree_leaves(batch)
+        sizes = {l.shape[0] for l in leaves if getattr(l, "ndim", 0) > 0}
+        ok = all(s % max(1, dp) == 0 for s in sizes)
+        if not ok and not self._warned_skip:
+            _log.warning(
+                "Skipping batch whose size %s does not divide across %d "
+                "data shards; use drop_last or a divisible batch size.",
+                sizes, dp)
+            self._warned_skip = True
+        return ok
+
+    # -- state init / restore -------------------------------------------
+
+    def _init_state(self, module, example_batch, strategy, ckpt_path):
+        gbatch = self._put_batch(example_batch, strategy)
+        init_jit = jax.jit(self._init_fn,
+                           out_shardings=self._state_shardings)
+        self.state = init_jit(self._init_rng, gbatch)
+
+        trained = getattr(module, "_trained_variables", None)
+        if ckpt_path:
+            self._restore_checkpoint(ckpt_path, module)
+        elif trained is not None:
+            # Reuse weights from a previous fit with this module (the
+            # reference keeps trained weights on the model object after
+            # post_dispatch loads them, ray_ddp.py:375-377).
+            restored = serialization.from_state_dict(
+                {"params": fetch_tree(self.state.params),
+                 "model_state": fetch_tree(self.state.model_state)},
+                trained)
+            self.state = self.state.replace(
+                params=jax.device_put(restored["params"],
+                                      self._state_shardings.params),
+                model_state=jax.device_put(
+                    restored["model_state"],
+                    self._state_shardings.model_state))
+
+    # -- fit loop --------------------------------------------------------
+
+    def _fit_loop(self, module, loaders):
+        train_loader, val_loader = loaders["train"], loaders.get("val")
+        strategy = self.plugin.strategy
+        self.num_val_batches = self._loader_len(val_loader,
+                                                self.limit_val_batches)
+
+        for cb in self.callbacks:
+            cb.on_fit_start(self, module)
+        module.on_fit_start()
+
+        if val_loader is not None and self.num_sanity_val_steps > 0 \
+                and self.num_val_batches > 0:
+            self._sanity_check(module, val_loader)
+
+        for cb in self.callbacks:
+            cb.on_train_start(self, module)
+        module.on_train_start()
+
+        start_epoch = self.current_epoch
+        epoch = start_epoch
+        try:
+            for epoch in range(start_epoch, self.max_epochs or 10**9):
+                self.current_epoch = epoch
+                if hasattr(train_loader, "set_epoch"):
+                    train_loader.set_epoch(epoch)
+                self._epoch_metric_acc = {}
+                for cb in self.callbacks:
+                    cb.on_train_epoch_start(self, module)
+                module.on_train_epoch_start()
+
+                self._train_epoch(module, train_loader, val_loader, strategy)
+
+                self._flush_epoch_metrics()
+                module.on_train_epoch_end()
+                for cb in self.callbacks:
+                    cb.on_train_epoch_end(self, module)
+
+                if val_loader is not None and self.num_val_batches > 0 \
+                        and (epoch + 1) % self.check_val_every_n_epoch == 0:
+                    self._eval_loop(module, "validate", val_loader,
+                                    self.limit_val_batches)
+                if self.should_stop or self._max_steps_reached():
+                    break
+        finally:
+            self.current_epoch = min(epoch + 1, self.max_epochs or epoch + 1) \
+                if not self.should_stop else epoch
+            module.on_train_end()
+            for cb in self.callbacks:
+                cb.on_train_end(self, module)
+            module.on_fit_end()
+            for cb in self.callbacks:
+                cb.on_fit_end(self, module)
+        return self._finalize_fit(module)
+
+    def _max_steps_reached(self) -> bool:
+        return self.max_steps is not None and self.max_steps >= 0 \
+            and self.global_step >= self.max_steps
+
+    def _train_epoch(self, module, train_loader, val_loader, strategy):
+        for batch_idx, batch in enumerate(train_loader):
+            if self.limit_train_batches is not None \
+                    and batch_idx >= self.limit_train_batches:
+                break
+            if not self._batch_ok(batch, strategy):
+                continue
+            for cb in self.callbacks:
+                cb.on_train_batch_start(self, module, batch, batch_idx)
+            gbatch = self._put_batch(batch, strategy)
+            self.state, metrics = self._train_step(self.state, gbatch)
+            self.global_step += 1
+            self._accumulate_metrics(metrics)
+            if self.global_step % self.log_every_n_steps == 0:
+                self._publish_metrics(metrics)
+            for cb in self.callbacks:
+                cb.on_train_batch_end(self, module, metrics, batch, batch_idx)
+            if self.val_check_interval \
+                    and self.global_step % self.val_check_interval == 0 \
+                    and val_loader is not None and self.num_val_batches > 0:
+                self._eval_loop(module, "validate", val_loader,
+                                self.limit_val_batches)
+            if self.should_stop or self._max_steps_reached():
+                break
+
+    # -- metrics ---------------------------------------------------------
+
+    def _accumulate_metrics(self, metrics: dict) -> None:
+        for k, v in metrics.items():
+            self._epoch_metric_acc.setdefault(k, []).append(v)
+
+    def _publish_metrics(self, metrics: dict) -> None:
+        for k, v in metrics.items():
+            val = float(jax.device_get(v))
+            self.callback_metrics[k] = val
+            self.logged_metrics[k] = val
+
+    def _flush_epoch_metrics(self) -> None:
+        for k, vals in self._epoch_metric_acc.items():
+            arr = np.asarray(jax.device_get(vals), dtype=np.float64)
+            self.callback_metrics[k] = float(arr.mean())
+            self.logged_metrics[k] = float(arr[-1])
+        self._epoch_metric_acc = {}
+
+    def _log_host_metric(self, name: str, value) -> None:
+        self.callback_metrics[name] = float(np.asarray(value))
+
+    # -- evaluation ------------------------------------------------------
+
+    def _loader_len(self, loader, limit) -> int:
+        if loader is None:
+            return 0
+        if limit == 0:
+            return 0
+        try:
+            n = len(loader)
+        except TypeError:
+            n = 10**9
+        return min(n, limit) if limit is not None else n
+
+    def _sanity_check(self, module, val_loader):
+        self.sanity_checking = True
+        for cb in self.callbacks:
+            cb.on_sanity_check_start(self, module)
+        self._eval_loop(module, "validate", val_loader,
+                        self.num_sanity_val_steps)
+        for cb in self.callbacks:
+            cb.on_sanity_check_end(self, module)
+        self.sanity_checking = False
+
+    def _eval_loop(self, module, stage: str, loader, limit) -> dict:
+        strategy = self.plugin.strategy
+        step = self._eval_steps[stage]
+        if stage == "validate":
+            for cb in self.callbacks:
+                cb.on_validation_start(self, module)
+            for cb in self.callbacks:
+                cb.on_validation_epoch_start(self, module)
+            module.on_validation_epoch_start()
+        else:
+            for cb in self.callbacks:
+                cb.on_test_start(self, module)
+
+        acc: list[tuple[dict, int]] = []
+        for batch_idx, batch in enumerate(loader):
+            if limit is not None and batch_idx >= limit:
+                break
+            if not self._batch_ok(batch, strategy):
+                continue
+            gbatch = self._put_batch(batch, strategy)
+            logged = step(self.state, gbatch)
+            leaves = jax.tree_util.tree_leaves(batch)
+            bsz = leaves[0].shape[0] if leaves and getattr(
+                leaves[0], "ndim", 0) > 0 else 1
+            acc.append((logged, bsz))
+            if stage == "validate":
+                for cb in self.callbacks:
+                    cb.on_validation_batch_end(self, module, logged, batch,
+                                               batch_idx)
+
+        means: dict[str, float] = {}
+        if acc:
+            keys = acc[0][0].keys()
+            total = sum(b for _, b in acc)
+            for k in keys:
+                vals = np.asarray(
+                    jax.device_get([d[k] for d, _ in acc]), dtype=np.float64)
+                weights = np.asarray([b for _, b in acc], dtype=np.float64)
+                means[k] = float((vals * weights).sum() / max(total, 1))
+        if not self.sanity_checking:
+            self.callback_metrics.update(means)
+            self.logged_metrics.update(means)
+
+        if stage == "validate":
+            module.on_validation_epoch_end()
+            for cb in self.callbacks:
+                cb.on_validation_epoch_end(self, module)
+            for cb in self.callbacks:
+                cb.on_validation_end(self, module)
+        else:
+            for cb in self.callbacks:
+                cb.on_test_epoch_end(self, module)
+            for cb in self.callbacks:
+                cb.on_test_end(self, module)
+        return means
+
+    def _run_eval_stage(self, module, stage, loaders):
+        loader = loaders["val" if stage == "validate" else "test"]
+        limit = (self.limit_val_batches if stage == "validate"
+                 else self.limit_test_batches)
+        means = self._eval_loop(module, stage, loader, limit)
+        return [means]
+
+    def _predict_loop(self, module, loaders):
+        strategy = self.plugin.strategy
+        loader = loaders["predict"]
+        for cb in self.callbacks:
+            cb.on_predict_start(self, module)
+        outputs = []
+        for batch_idx, batch in enumerate(loader):
+            if self.limit_predict_batches is not None \
+                    and batch_idx >= self.limit_predict_batches:
+                break
+            if not self._batch_ok(batch, strategy):
+                continue
+            gbatch = self._put_batch(batch, strategy)
+            out = self._predict_step(self.state, gbatch)
+            outputs.append(fetch_tree(out))
+        for cb in self.callbacks:
+            cb.on_predict_end(self, module)
+        return outputs
+
+    # -- finalization / results round-trip -------------------------------
+
+    def _finalize_fit(self, module):
+        self._flush_epoch_metrics()
+        trained = {"params": fetch_tree(self.state.params),
+                   "model_state": fetch_tree(self.state.model_state)}
+        module._trained_variables = trained
+        return {"callback_metrics": dict(self.callback_metrics)}
+
+    # ------------------------------------------------------------------
+    # checkpointing
+    # ------------------------------------------------------------------
+
+    def save_checkpoint(self, filepath: str) -> None:
+        """Collective: every process participates in the gather; only
+        global-zero writes (fsspec so GCS paths work on pods —
+        SURVEY.md §7 best-path/locality hazard)."""
+        module = self.lightning_module
+        ckpt = {
+            "epoch": int(self.current_epoch),
+            "global_step": int(self.global_step),
+            "state": serialization.to_state_dict(fetch_tree(self.state)),
+            "hparams": _sanitize(dict(module.hparams)) if module else {},
+            "callbacks": {type(cb).__name__: _sanitize(cb.state_dict())
+                          for cb in self.callbacks},
+            "world_size": int(self.world_size),
+            "strategy": self.plugin.strategy.name
+            if self.plugin.strategy else "none",
+        }
+        if module is not None:
+            module.on_save_checkpoint(ckpt)
+        for cb in self.callbacks:
+            cb.on_save_checkpoint(self, module, ckpt)
+        if self.is_global_zero:
+            payload = serialization.msgpack_serialize(ckpt)
+            dirname = os.path.dirname(filepath)
+            if dirname and "://" not in filepath:
+                os.makedirs(dirname, exist_ok=True)
+            # atomic-ish local write; remote filesystems via fsspec
+            if "://" in filepath:
+                with fsspec.open(filepath, "wb") as f:
+                    f.write(payload)
+            else:
+                fd, tmp = tempfile.mkstemp(dir=dirname or ".")
+                with os.fdopen(fd, "wb") as f:
+                    f.write(payload)
+                os.replace(tmp, filepath)
+
+    @staticmethod
+    def load_checkpoint_dict(filepath: str) -> dict:
+        with fsspec.open(filepath, "rb") as f:
+            return serialization.msgpack_restore(f.read())
+
+    def _restore_checkpoint(self, filepath: str, module) -> None:
+        ckpt = self.load_checkpoint_dict(filepath)
+        # Re-shard on load: checkpoints always hold the full (gathered)
+        # state, so resuming with a different world size / strategy just
+        # re-distributes (covers the reference's resume-with-fewer-workers
+        # case, test_ddp_sharded.py:119-138).
+        restored = serialization.from_state_dict(
+            fetch_tree(self.state), ckpt["state"])
+        self.state = jax.device_put(restored, self._state_shardings)
+        self.current_epoch = int(ckpt.get("epoch", 0))
+        self.global_step = int(ckpt.get("global_step", 0))
+        cb_states = ckpt.get("callbacks", {})
+        for cb in self.callbacks:
+            st = cb_states.get(type(cb).__name__)
+            if st:
+                cb.load_state_dict(st)
+        if module is not None:
+            module.on_load_checkpoint(ckpt)
+        for cb in self.callbacks:
+            cb.on_load_checkpoint(self, module, ckpt)
+
+    # elapsed-time helper used by examples/benchmarks
+    @staticmethod
+    def _now() -> float:
+        return time.monotonic()
+
+
+def _peek_first_batch(loader):
+    """Grab one batch for shape inference without losing it.
+
+    Re-iterable loaders (anything with ``__len__``) are returned as-is;
+    one-shot iterables are wrapped so the peeked batch is replayed at the
+    start of the (single) pass.
+    """
+    it = iter(loader)
+    first = next(it)
+    if hasattr(loader, "__len__"):
+        return first, loader
+    return first, _ChainedLoader(first, it)
+
+
+class _ChainedLoader:
+    def __init__(self, first, rest_iter):
+        self._first = first
+        self._rest = rest_iter
+        self._consumed = False
+
+    def __iter__(self):
+        if self._consumed:
+            return iter(())  # one-shot source: second pass is empty
+        self._consumed = True
+        import itertools
+        return itertools.chain([self._first], self._rest)
+
+
+def _sanitize(obj):
+    """Make a nested structure msgpack-serializable (tuples→lists, numpy
+    scalars→python, drop non-serializable leaves)."""
+    if isinstance(obj, dict):
+        return {str(k): _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize(v) for v in obj]
+    if isinstance(obj, (np.generic,)):
+        return obj.item()
+    if isinstance(obj, (str, bytes, int, float, bool, type(None),
+                        np.ndarray)):
+        return obj
+    if isinstance(obj, jax.Array):
+        return np.asarray(jax.device_get(obj))
+    return repr(obj)

@@ -1,0 +1,11 @@
+from ray_lightning_tpu.models.boring import (
+    BoringModel,
+    LightningMNISTClassifier,
+    RandomDataset,
+)
+
+__all__ = [
+    "BoringModel",
+    "LightningMNISTClassifier",
+    "RandomDataset",
+]

@@ -1,0 +1,19 @@
+from ray_lightning_tpu.parallel.mesh import build_device_mesh
+from ray_lightning_tpu.parallel.strategy import (
+    DataParallelStrategy,
+    FullyShardedStrategy,
+    ShardingStrategy,
+    SpmdStrategy,
+    Zero1Strategy,
+    resolve_strategy,
+)
+
+__all__ = [
+    "build_device_mesh",
+    "ShardingStrategy",
+    "DataParallelStrategy",
+    "Zero1Strategy",
+    "FullyShardedStrategy",
+    "SpmdStrategy",
+    "resolve_strategy",
+]

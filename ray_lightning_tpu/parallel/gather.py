@@ -1,0 +1,48 @@
+"""Host fetch of (possibly multi-host-sharded) global arrays.
+
+Checkpointing and the rank-0→driver state stream need full host values.
+Single-process arrays are fetched directly; arrays spanning processes are
+first replicated by one compiled identity program (XLA all-gather over
+ICI/DCN — every process must call this together), then read from the
+local shard.  This is how ZeRO-sharded optimizer state gets gathered into
+world-size-independent checkpoints (SURVEY.md §5 checkpoint notes;
+resume-with-different-world-size parity, test_ddp_sharded.py:119-138).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _replicate_leaves(leaves: list) -> list:
+    """All-gather non-addressable leaves to full replication in ONE jitted
+    program (single compilation, single collective schedule)."""
+    mesh = leaves[0].sharding.mesh
+    shardings = tuple(NamedSharding(mesh, P()) for _ in leaves)
+    return jax.jit(lambda *xs: xs, out_shardings=shardings)(*leaves)
+
+
+def fetch_tree(tree: Any) -> Any:
+    """Pytree of global jax.Arrays → pytree of full host numpy arrays."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    pending = [i for i, l in enumerate(leaves)
+               if isinstance(l, jax.Array) and not l.is_fully_addressable]
+    if pending:
+        replicated = _replicate_leaves([leaves[i] for i in pending])
+        for i, r in zip(pending, replicated):
+            leaves[i] = r
+
+    def to_host(x):
+        if not isinstance(x, jax.Array):
+            return x
+        if x.is_fully_addressable:
+            return np.asarray(jax.device_get(x))
+        # replicated across processes: the local shard is the full value
+        return np.asarray(x.addressable_shards[0].data)
+
+    return jax.tree_util.tree_unflatten(treedef,
+                                        [to_host(l) for l in leaves])

@@ -1,0 +1,14 @@
+from ray_lightning_tpu.plugins.base import ExecutionPlugin, LocalPlugin
+from ray_lightning_tpu.plugins.xla import (
+    RayXlaPlugin,
+    RayXlaShardedPlugin,
+    RayXlaSpmdPlugin,
+)
+
+__all__ = [
+    "ExecutionPlugin",
+    "LocalPlugin",
+    "RayXlaPlugin",
+    "RayXlaShardedPlugin",
+    "RayXlaSpmdPlugin",
+]

@@ -1,0 +1,46 @@
+"""Execution plugins: who runs the training loop, and where.
+
+``LocalPlugin`` runs it in-process (SPMD over whatever devices this
+process sees — one v4-8 host, or 8 virtual CPU devices in tests).
+Distributed plugins (plugins/xla.py) ship the run into actor workers.
+The plugin's second job is carrying the sharding strategy.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ray_lightning_tpu.parallel.strategy import (
+    ShardingStrategy,
+    resolve_strategy,
+)
+
+
+class ExecutionPlugin:
+    strategy: Optional[ShardingStrategy] = None
+
+    def run(self, trainer, module, datamodule, stage: str,
+            ckpt_path: Optional[str]):
+        raise NotImplementedError
+
+    def local_devices(self) -> Optional[Sequence]:
+        """Devices the mesh should span (None = all visible devices)."""
+        return None
+
+
+class LocalPlugin(ExecutionPlugin):
+    """In-process execution (no actors).  The default when no distributed
+    plugin is passed — the analog of running PL without any plugin, but
+    still SPMD across every local chip."""
+
+    def __init__(self, strategy=None, devices: Optional[Sequence] = None):
+        self.strategy = resolve_strategy(strategy) if strategy else None
+        self._devices = devices
+
+    def run(self, trainer, module, datamodule, stage, ckpt_path):
+        if self.strategy is None:
+            self.strategy = resolve_strategy(None)
+        return trainer._run_stage(module, datamodule, stage, ckpt_path)
+
+    def local_devices(self):
+        return self._devices

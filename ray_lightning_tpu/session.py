@@ -1,0 +1,64 @@
+"""Per-worker session singleton (reference: ray_lightning/session.py:6-63).
+
+Holds (rank, queue-proxy) inside each worker so callbacks deep in the
+training loop can relay side-effects to the driver without plumbing
+handles through every layer — the load-bearing trick behind Tune
+integration ("relay the side-effect, not the call", SURVEY.md §3.3).
+Same strict double-init / uninitialized-access contract as the reference
+(session.py:30-48).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+
+class RLTSession:
+    def __init__(self, rank: int, queue: Optional[Any]):
+        self._rank = rank
+        self._queue = queue
+
+    def get_actor_rank(self) -> int:
+        return self._rank
+
+    def put_queue(self, item: Any) -> None:
+        if self._queue is None:
+            raise ValueError(
+                "RLTSession has no queue: this run was not launched with a "
+                "driver-side queue (Tune callbacks require one).")
+        self._queue.put((self._rank, item))
+
+
+_session: Optional[RLTSession] = None
+
+
+def init_session(rank: int, queue: Optional[Any]) -> None:
+    global _session
+    if _session is not None:
+        raise ValueError(
+            "A ray_lightning_tpu session is already initialized in this "
+            "process; init_session may be called only once.")
+    _session = RLTSession(rank, queue)
+
+
+def get_session() -> RLTSession:
+    if _session is None:
+        raise ValueError(
+            "No ray_lightning_tpu session in this process; was this called "
+            "outside a launched worker?")
+    return _session
+
+
+def reset_session() -> None:
+    global _session
+    _session = None
+
+
+def get_actor_rank() -> int:
+    return get_session().get_actor_rank()
+
+
+def put_queue(item: Callable | Any) -> None:
+    """Enqueue an item (usually a zero-arg callable) for execution on the
+    driver (session.py:17-24 + util.py:47-52 analog)."""
+    get_session().put_queue(item)

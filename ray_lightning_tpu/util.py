@@ -1,0 +1,57 @@
+"""Driver-side plumbing (reference: ray_lightning/util.py:47-90).
+
+``process_results`` is the driver's poll loop: wait on worker futures
+while draining the worker→driver queue and executing relayed callables
+(Tune reports/checkpoints) in the driver process — the "relay the
+side-effect, not the call" pattern (SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Sequence
+
+from ray_lightning_tpu.cluster.backend import ClusterBackend, Future
+from ray_lightning_tpu.utils.states import load_state_stream, to_state_stream
+
+__all__ = ["process_results", "to_state_stream", "load_state_stream"]
+
+
+def _handle_queue_item(item: Any) -> None:
+    """Execute one queue item on the driver.  Items are ``(rank, payload)``
+    tuples; callable payloads are invoked here so driver-context APIs
+    (e.g. the tune session) work (util.py:47-52 analog)."""
+    if isinstance(item, tuple) and len(item) == 2:
+        _rank, payload = item
+    else:
+        payload = item
+    if callable(payload):
+        payload()
+
+
+def process_results(futures: Sequence[Future], backend: ClusterBackend,
+                    poll_interval: float = 0.02) -> list:
+    """Busy-poll worker futures, relaying queue items as they arrive
+    (util.py:55-68 analog).  A worker error raises immediately, failing
+    the whole run (parity with ray.get semantics, util.py:61-63)."""
+    pending = list(futures)
+    while not all(f.done() for f in pending):
+        drained = False
+        while True:
+            item = backend.queue_get_nowait()
+            if item is None:
+                break
+            drained = True
+            _handle_queue_item(item)
+        for f in pending:
+            if f.done():
+                f.result()  # raise worker errors eagerly
+        if not drained:
+            time.sleep(poll_interval)
+    # final drain: items enqueued just before workers finished
+    while True:
+        item = backend.queue_get_nowait()
+        if item is None:
+            break
+        _handle_queue_item(item)
+    return [f.result() for f in pending]

@@ -1,0 +1,19 @@
+from ray_lightning_tpu.utils.imports import (
+    RAY_AVAILABLE,
+    TORCH_AVAILABLE,
+    Unavailable,
+)  # noqa: F401  (Unavailable/TORCH_AVAILABLE: optional-dep gate surface)
+from ray_lightning_tpu.utils.seed import seed_everything
+from ray_lightning_tpu.utils.states import (
+    load_state_stream,
+    to_state_stream,
+)
+
+__all__ = [
+    "RAY_AVAILABLE",
+    "TORCH_AVAILABLE",
+    "Unavailable",
+    "seed_everything",
+    "to_state_stream",
+    "load_state_stream",
+]

@@ -1,0 +1,46 @@
+"""Optional-dependency guards.
+
+Mirrors the reference's ``Unavailable`` sentinel + ``TUNE_INSTALLED`` /
+``HOROVOD_AVAILABLE`` flag pattern (reference: ray_lightning/util.py:40-44,
+ray_lightning/tune.py:13-27, ray_lightning/ray_horovod.py:17-25): a missing
+optional dependency is replaced by a class that raises a clear error on
+*use*, never on import, so the core framework degrades gracefully.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+
+
+class Unavailable:
+    """Placeholder for a class from a dependency that is not installed.
+
+    Raises on instantiation (not on import), matching the reference's
+    contract (util.py:40-44).
+    """
+
+    _reason = "This class requires a dependency that is not installed."
+
+    def __init__(self, *args, **kwargs):
+        raise ImportError(self._reason)
+
+    def __init_subclass__(cls, **kwargs):
+        raise ImportError(cls._reason)
+
+
+def _has(mod: str) -> bool:
+    try:
+        return importlib.util.find_spec(mod) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+#: True when a real Ray runtime is importable.  The built-in subprocess
+#: actor backend (cluster/local.py) is used otherwise, so unlike the
+#: reference — which hard-requires Ray (setup.py:12) — everything here
+#: works without it.
+RAY_AVAILABLE: bool = _has("ray")
+
+#: torch is only used for interop (datasets / DataLoader collation and
+#: torch-tensor batch conversion); the compute path is pure JAX.
+TORCH_AVAILABLE: bool = _has("torch")

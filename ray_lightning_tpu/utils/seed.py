@@ -1,0 +1,41 @@
+"""Deterministic seeding across driver and workers.
+
+Replaces PL's ``seed_everything`` / ``reset_seed`` which the reference
+invokes per worker before process-group init (ray_ddp.py:403-405).  The
+seed is propagated driver→worker through the ``RLT_GLOBAL_SEED`` env var,
+the analog of ``PL_GLOBAL_SEED`` (ray_ddp.py:213-219).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+import numpy as np
+
+SEED_ENV_VAR = "RLT_GLOBAL_SEED"
+
+
+def seed_everything(seed: int | None = None) -> int:
+    """Seed python, numpy and record the seed for JAX PRNG-key derivation.
+
+    JAX has no global RNG: modules derive ``jax.random.key(seed)`` streams
+    from the returned value (Trainer does this per fit).  Returns the seed
+    so callers can thread it explicitly.
+    """
+    if seed is None:
+        env = os.environ.get(SEED_ENV_VAR)
+        seed = int(env) if env is not None else random.randint(0, 2**31 - 1)
+    seed = int(seed)
+    os.environ[SEED_ENV_VAR] = str(seed)
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    return seed
+
+
+def reset_seed() -> int | None:
+    """Re-apply the seed recorded in the env, if any (worker-side)."""
+    env = os.environ.get(SEED_ENV_VAR)
+    if env is None:
+        return None
+    return seed_everything(int(env))

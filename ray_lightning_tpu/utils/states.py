@@ -1,0 +1,56 @@
+"""State streams: in-band serialization of parameter/optimizer pytrees.
+
+The reference round-trips trained weights from rank-0 worker to driver as
+an in-memory byte stream (torch.save → BytesIO, util.py:71-90) because
+PL's temp-file handoff breaks multi-node (rationale at ray_ddp.py:480-486).
+Same shape here, but TPU-native: pytrees of ``jax.Array`` are fetched to
+host, converted to numpy and serialized with flax's msgpack codec — no
+pickle on the hot path, no torch dependency, and the stream is
+platform-independent (a stream produced on a TPU pod loads on a CPU-only
+driver).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+def _to_host(tree: Any) -> Any:
+    """Fetch a pytree of (possibly sharded, device-resident) arrays to host
+    numpy.  For multi-host global arrays callers must gather addressable
+    shards first (see parallel/gather.py)."""
+
+    def _leaf(x):
+        if isinstance(x, jax.Array):
+            return np.asarray(jax.device_get(x))
+        if isinstance(x, (np.ndarray, np.generic, int, float, bool, bytes, str)):
+            return x
+        return x
+
+    return jax.tree_util.tree_map(_leaf, tree)
+
+
+def to_state_stream(state: Any) -> bytes:
+    """Serialize a pytree of arrays into a byte stream (util.py:71-75 analog)."""
+    host_tree = serialization.to_state_dict(_to_host(state))
+    return serialization.msgpack_serialize(host_tree)
+
+
+def load_state_stream(stream: bytes, target: Any | None = None) -> Any:
+    """Deserialize a state stream.
+
+    Without ``target``, returns the raw nested-dict-of-numpy form.  With
+    ``target`` (a pytree of matching structure), restores into that
+    structure via flax's ``from_state_dict`` — the analog of the
+    ``map_location`` rehydration in util.py:78-90, except placement is
+    deferred to the caller (JAX arrays are placed by the jitted program's
+    shardings, not at deserialization time).
+    """
+    tree = serialization.msgpack_restore(stream)
+    if target is None:
+        return tree
+    return serialization.from_state_dict(target, tree)

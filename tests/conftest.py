@@ -1,0 +1,43 @@
+"""Test harness config.
+
+Forces the CPU platform with 8 virtual XLA devices (the reference tests
+against gloo on CPU CI runners the same way, SURVEY.md §4) BEFORE jax
+initializes its backend.  Worker subprocesses spawned by distributed
+tests get their platform via plugin env plumbing instead.
+"""
+
+import os
+
+# Must happen before jax backend init: append the virtual-device flag.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from ray_lightning_tpu.utils.seed import seed_everything  # noqa: E402
+
+
+@pytest.fixture
+def seed():
+    seed_everything(0)
+
+
+@pytest.fixture
+def tmp_root(tmp_path):
+    return str(tmp_path)
+
+
+def assert_tree_allclose(a, b, rtol=1e-5, atol=1e-6):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=rtol, atol=atol)

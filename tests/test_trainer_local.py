@@ -1,0 +1,181 @@
+"""Core trainer tests: single-process, in-process SPMD over 8 virtual CPU
+devices (the 'no plugin' path, plus strategy coverage)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import (
+    EarlyStopping,
+    ModelCheckpoint,
+    Trainer,
+)
+from ray_lightning_tpu.models import BoringModel, LightningMNISTClassifier
+
+from tests.utils import get_trainer, load_test, predict_test, train_test
+
+
+def test_devices_virtual():
+    assert jax.device_count() == 8
+
+
+def test_fit_boring(tmp_path, seed):
+    trainer = get_trainer(str(tmp_path))
+    train_test(trainer, BoringModel())
+
+
+def test_metrics_logged(tmp_path, seed):
+    trainer = get_trainer(str(tmp_path))
+    trainer.fit(BoringModel())
+    assert "loss" in trainer.callback_metrics
+    assert "val_loss" in trainer.callback_metrics
+    assert np.isfinite(trainer.callback_metrics["loss"])
+
+
+def test_loss_decreases(tmp_path, seed):
+    trainer = get_trainer(str(tmp_path), max_epochs=3,
+                          limit_train_batches=16)
+    module = BoringModel(lr=0.05)
+    trainer.fit(module)
+    # after 3 epochs driving outputs to zero, loss must shrink well
+    assert trainer.callback_metrics["loss"] < 1.0
+
+
+def test_validate_and_test_stages(tmp_path, seed):
+    trainer = get_trainer(str(tmp_path))
+    module = BoringModel()
+    trainer.fit(module)
+    val = trainer.validate(module)
+    assert "val_loss" in val[0]
+    out = trainer.test(module)
+    assert "test_loss" in out[0]
+
+
+def test_predict_returns_outputs(tmp_path, seed):
+    trainer = get_trainer(str(tmp_path))
+    module = BoringModel()
+    trainer.fit(module)
+    outputs = trainer.predict(module)
+    assert len(outputs) > 0
+    assert np.concatenate([np.asarray(o) for o in outputs]).shape[1] == 2
+
+
+def test_mnist_learns(tmp_path, seed):
+    trainer = get_trainer(str(tmp_path), max_epochs=3,
+                          limit_train_batches=16, limit_val_batches=4)
+    predict_test(trainer, LightningMNISTClassifier())
+
+
+def test_checkpoint_saved_and_loads(tmp_path, seed):
+    trainer = get_trainer(str(tmp_path))
+    load_test(trainer, BoringModel())
+
+
+def test_resume_from_checkpoint(tmp_path, seed):
+    trainer = get_trainer(str(tmp_path), max_epochs=1)
+    module = BoringModel()
+    trainer.fit(module)
+    ckpt = trainer.checkpoint_callback.best_model_path
+    trainer2 = get_trainer(str(tmp_path), max_epochs=2)
+    module2 = BoringModel()
+    trainer2.fit(module2, ckpt_path=ckpt)
+    assert trainer2.current_epoch >= 1
+    assert trainer2.global_step > trainer.global_step
+
+
+def test_early_stopping(tmp_path, seed):
+    """EarlyStopping halts before max_epochs (test_ddp.py:287-306 shape)."""
+    es = EarlyStopping(monitor="val_loss", patience=1, mode="min",
+                       min_delta=100.0)  # impossible improvement bar
+    trainer = get_trainer(str(tmp_path), max_epochs=20, callbacks=[es])
+    trainer.fit(BoringModel())
+    assert trainer.current_epoch < 20
+
+
+def test_model_checkpoint_monitor_best(tmp_path, seed):
+    mc = ModelCheckpoint(monitor="val_loss", mode="min", save_top_k=1,
+                         dirpath=str(tmp_path / "ckpts"))
+    trainer = get_trainer(str(tmp_path), max_epochs=3, callbacks=[mc],
+                          checkpoint=False)
+    trainer.callbacks.append(mc) if mc not in trainer.callbacks else None
+    trainer.fit(BoringModel(lr=0.05))
+    assert mc.best_model_path
+    assert os.path.exists(mc.best_model_path)
+    assert mc.best_model_score is not None
+
+
+def test_max_steps(tmp_path, seed):
+    trainer = get_trainer(str(tmp_path), max_epochs=10, max_steps=5)
+    trainer.fit(BoringModel())
+    assert trainer.global_step == 5
+
+
+def test_gradient_accumulation(tmp_path, seed):
+    trainer = get_trainer(str(tmp_path), accumulate_grad_batches=2)
+    module = BoringModel(batch_size=4)
+    trainer.fit(module)
+    assert "loss" in trainer.callback_metrics
+
+
+def test_gradient_clipping(tmp_path, seed):
+    trainer = get_trainer(str(tmp_path), gradient_clip_val=0.1)
+    trainer.fit(BoringModel())
+    assert np.isfinite(trainer.callback_metrics["loss"])
+
+
+@pytest.mark.parametrize("strategy", ["ddp", "zero1", "fsdp"])
+def test_strategies_train(tmp_path, seed, strategy):
+    """Every sharding strategy trains the same model to a moving-weights
+    state on the 8-device mesh."""
+    trainer = get_trainer(str(tmp_path), strategy=strategy)
+    train_test(trainer, BoringModel(batch_size=8))
+
+
+def test_zero1_opt_state_is_sharded(tmp_path, seed):
+    trainer = get_trainer(str(tmp_path), strategy="zero1", max_epochs=1,
+                          limit_train_batches=2)
+    module = BoringModel(batch_size=8, dataset_length=64)
+    trainer.fit(module)
+    # Adam-free SGD has no per-param opt state; use the kernel of a model
+    # with adam instead: check sharding on the mnist classifier.
+    trainer2 = get_trainer(str(tmp_path), strategy="zero1", max_epochs=1,
+                           limit_train_batches=2)
+    m2 = LightningMNISTClassifier(config={"batch_size": 32})
+    trainer2.fit(m2)
+    shardings = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda x: x.sharding,
+                               trainer2.state.opt_state))
+    assert any(
+        any(ax is not None for ax in s.spec) for s in shardings
+        if hasattr(s, "spec")), "no opt-state leaf is sharded under zero1"
+
+
+def test_strategy_results_match_ddp_vs_zero1(tmp_path, seed):
+    """ZeRO-1 must be numerically equivalent to DDP (same seed/data)."""
+    from tests.conftest import assert_tree_allclose
+    results = {}
+    for name in ("ddp", "zero1"):
+        trainer = get_trainer(str(tmp_path) + name, strategy=name,
+                              max_epochs=1, limit_train_batches=4,
+                              checkpoint=False, seed=123)
+        module = LightningMNISTClassifier(config={"batch_size": 32})
+        trainer.fit(module)
+        results[name] = module._trained_variables["params"]
+    assert_tree_allclose(results["ddp"], results["zero1"],
+                         rtol=2e-4, atol=1e-5)
+
+
+def test_fit_then_refit_reuses_weights(tmp_path, seed):
+    module = BoringModel(lr=0.05)
+    t1 = get_trainer(str(tmp_path), max_epochs=1)
+    t1.fit(module)
+    w1 = module._trained_variables["params"]
+    t2 = get_trainer(str(tmp_path), max_epochs=1, checkpoint=False)
+    t2.fit(module)
+    w2 = module._trained_variables["params"]
+    deltas = [np.linalg.norm(np.asarray(a) - np.asarray(b))
+              for a, b in zip(jax.tree_util.tree_leaves(w1),
+                              jax.tree_util.tree_leaves(w2))]
+    assert sum(deltas) > 0  # continued training moved weights further

@@ -1,0 +1,90 @@
+"""Shared behavioral assertions (reference: tests/utils.py:151-210).
+
+- ``get_trainer``: trainer factory with CI-sized limits (utils.py:151-171)
+- ``train_test``: weights actually changed after remote training and
+  round-tripped to the driver (utils.py:174-183)
+- ``load_test``: the best checkpoint file loads (utils.py:186-191)
+- ``predict_test``: trained classifier beats chance — end-to-end learning
+  signal (utils.py:194-210)
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from ray_lightning_tpu import Trainer
+
+
+def get_trainer(root_dir, plugins=None, max_epochs: int = 1,
+                limit_train_batches: int = 10, limit_val_batches: int = 2,
+                callbacks=None, checkpoint: bool = True, strategy=None,
+                **kwargs):
+    return Trainer(
+        default_root_dir=root_dir,
+        callbacks=callbacks,
+        plugins=plugins,
+        strategy=strategy,
+        max_epochs=max_epochs,
+        limit_train_batches=limit_train_batches,
+        limit_val_batches=limit_val_batches,
+        enable_checkpointing=checkpoint,
+        num_sanity_val_steps=0,
+        log_every_n_steps=1,
+        **kwargs,
+    )
+
+
+def _flat_norm_delta(before, after) -> float:
+    total = 0.0
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(after)):
+        total += float(np.linalg.norm(np.asarray(a) - np.asarray(b)))
+    return total
+
+
+def initial_params(module):
+    """Initialize a copy of the module's params on the driver for
+    before/after comparison."""
+    import jax.numpy as jnp
+    module.setup_model()
+    batch = next(iter(module.train_dataloader()))
+    x = batch[0] if isinstance(batch, (tuple, list)) else batch
+    variables = module.model.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    return jax.device_get(variables["params"])
+
+
+def train_test(trainer, module):
+    """Train and assert the driver-visible weights moved
+    (utils.py:174-183 analog)."""
+    before = initial_params(module)
+    trainer.fit(module)
+    after = module._trained_variables["params"]
+    assert _flat_norm_delta(before, after) > 0.1
+
+
+def load_test(trainer, module):
+    """Best checkpoint exists and loads (utils.py:186-191 analog)."""
+    trainer.fit(module)
+    ckpt_path = trainer.checkpoint_callback.best_model_path
+    assert ckpt_path and os.path.exists(ckpt_path), ckpt_path
+    ckpt = Trainer.load_checkpoint_dict(ckpt_path)
+    assert "state" in ckpt and "params" in ckpt["state"]
+
+
+def predict_test(trainer, module, datamodule=None):
+    """Fit then predict; accuracy must beat chance
+    (utils.py:194-210 analog)."""
+    trainer.fit(module, datamodule)
+    outputs = trainer.predict(module, datamodule)
+    preds = np.concatenate([np.asarray(o) for o in outputs])
+    loader = (datamodule.predict_dataloader() if datamodule is not None
+              else module.predict_dataloader())
+    labels = []
+    for batch in loader:
+        labels.append(np.asarray(batch[1]))
+    labels = np.concatenate(labels)[:len(preds)]
+    acc = float((preds == labels).mean())
+    assert acc >= 0.5, f"accuracy {acc} below 0.5"
