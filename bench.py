@@ -1,0 +1,88 @@
+"""Benchmark: GPT-2-small training steps/sec through the full framework
+path (Trainer → compiled SPMD train step) on whatever accelerator is
+attached (one TPU chip under the driver; CPU elsewhere).
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "steps/sec", "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md); ``vs_baseline`` is
+measured against the stored first-round value below so rounds are
+comparable to each other.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+# First recorded values per (platform, config) so vs_baseline always
+# compares like with like.  TPU: one v5e chip, gpt2-small, batch 8,
+# seq 512 (round-1 measurement).  CPU: tiny config, smoke-run hardware.
+BASELINES = {
+    "gpt2s_train_steps_per_sec_tpu": 27.0,
+    "gpt2tiny_train_steps_per_sec_cpu": 25.0,
+}
+
+WARMUP_STEPS = 3
+TIMED_STEPS = 30
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from ray_lightning_tpu import Trainer
+    from ray_lightning_tpu.core.callbacks import Callback
+    from ray_lightning_tpu.models.gpt import CONFIGS, GPTLightningModule
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        # keep CPU smoke runs tractable; the driver benches on TPU
+        cfg, batch = CONFIGS["tiny"], 8
+        metric = "gpt2tiny_train_steps_per_sec_cpu"
+    else:
+        cfg, batch = CONFIGS["gpt2-small"], 8
+        metric = f"gpt2s_train_steps_per_sec_{platform}"
+
+    module = GPTLightningModule(
+        cfg, dataset_size=batch * (WARMUP_STEPS + TIMED_STEPS),
+        batch_size=batch)
+
+    class Timer(Callback):
+        def __init__(self):
+            self.t0 = None
+            self.elapsed = None
+
+        def on_train_batch_end(self, trainer, mod, metrics, batch, idx):
+            # device→host fetch of the loss scalar is the sync point
+            # (block_until_ready does not reliably drain remote-tunnel
+            # platforms, so fetch a value instead)
+            if trainer.global_step == WARMUP_STEPS:
+                float(np.asarray(metrics["loss"]))
+                self.t0 = time.monotonic()
+            elif trainer.global_step == WARMUP_STEPS + TIMED_STEPS:
+                float(np.asarray(metrics["loss"]))
+                self.elapsed = time.monotonic() - self.t0
+
+    timer = Timer()
+    trainer = Trainer(
+        max_steps=WARMUP_STEPS + TIMED_STEPS, max_epochs=1,
+        enable_checkpointing=False, num_sanity_val_steps=0,
+        limit_val_batches=0, log_every_n_steps=10**9,
+        callbacks=[timer], seed=0)
+    trainer.fit(module)
+
+    assert timer.elapsed is not None, "benchmark did not reach timed steps"
+    steps_per_sec = TIMED_STEPS / timer.elapsed
+    baseline = BASELINES.get(metric, steps_per_sec)
+    print(json.dumps({
+        "metric": metric,
+        "value": round(steps_per_sec, 3),
+        "unit": "steps/sec",
+        "vs_baseline": round(steps_per_sec / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

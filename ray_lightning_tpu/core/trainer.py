@@ -335,27 +335,40 @@ class Trainer:
         self._abstract_state = abstract
         shardings = strategy.state_shardings(self._mesh, abstract)
         self._state_shardings = shardings
+        # Batch placement rides the jit call (in_shardings) instead of an
+        # explicit per-step device_put: a numpy batch is transferred and
+        # sharded as part of async dispatch.  (Per-array device_put with a
+        # NamedSharding is a blocking slow-path transfer per leaf —
+        # measured 30x slower on remote TPU tunnels — so on single-device
+        # meshes the batch stays unconstrained and takes the fast default
+        # transfer path.)
+        jit_kwargs = dict(donate_argnums=0, out_shardings=(shardings, None))
+        if self._mesh.devices.size > 1:
+            batch_sh = strategy.batch_shardings(self._mesh, example_batch)
+            jit_kwargs["in_shardings"] = (shardings, batch_sh)
         self._train_step = jax.jit(
             build_train_step(module, self._tx, self.accumulate_grad_batches),
-            donate_argnums=0, out_shardings=(shardings, None))
+            **jit_kwargs)
         self._eval_steps = {
-            s: jax.jit(build_eval_step(module, s))
+            s: _ShardedStepCache(build_eval_step(module, s), self, strategy)
             for s in ("validate", "test")}
-        self._predict_step = jax.jit(build_predict_step(module))
+        self._predict_step = _ShardedStepCache(build_predict_step(module),
+                                               self, strategy)
 
     def _put_batch(self, batch, strategy):
-        """Host numpy batch → global device arrays with the strategy's
-        sharding.  Multi-process: each process contributes its local shard
-        (``make_array_from_process_local_data``) — the TPU-native
-        equivalent of DistributedSampler feeding per-rank DDP replicas."""
-        shardings = strategy.batch_shardings(self._mesh, batch)
+        """Host numpy batch → step input.  Multi-process: each process
+        contributes its local shard (``make_array_from_process_local_data``)
+        to a global array — the TPU-native equivalent of DistributedSampler
+        feeding per-rank DDP replicas.  Single-process: numpy passes
+        straight into the jitted step, whose ``in_shardings`` shard it
+        during dispatch."""
         if jax.process_count() > 1:
+            shardings = strategy.batch_shardings(self._mesh, batch)
             return jax.tree_util.tree_map(
                 lambda x, s: jax.make_array_from_process_local_data(
                     s, np.asarray(x)),
                 batch, shardings)
-        return jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(np.asarray(x), s), batch, shardings)
+        return jax.tree_util.tree_map(np.asarray, batch)
 
     def _batch_ok(self, batch, strategy) -> bool:
         """Leading dim must divide over data shards (XLA static shapes)."""
@@ -605,9 +618,42 @@ class Trainer:
                 continue
             gbatch = self._put_batch(batch, strategy)
             out = self._predict_step(self.state, gbatch)
-            outputs.append(fetch_tree(out))
+            fetched = fetch_tree(out)   # all-gathered: the GLOBAL batch
+            if jax.process_count() > 1:
+                fetched = _deinterleave_global_batch(
+                    fetched, jax.process_count())
+            outputs.append(fetched)
+        outputs = self._trim_predict_padding(outputs, loader)
         for cb in self.callbacks:
             cb.on_predict_end(self, module)
+        return outputs
+
+    @staticmethod
+    def _trim_predict_padding(outputs, loader):
+        """Drop trailing wrap-around rows added by strided sharding
+        (DataLoader._indices pads so every shard is equal length)."""
+        if not outputs or getattr(loader, "num_shards", 1) <= 1:
+            return outputs
+        ds = getattr(loader, "dataset", None)
+        if ds is None or not hasattr(ds, "__len__"):
+            return outputs
+        def rows(o):
+            leaves = [l for l in jax.tree_util.tree_leaves(o)
+                      if getattr(l, "ndim", 0) > 0]
+            return leaves[0].shape[0] if leaves else None
+
+        counts = [rows(o) for o in outputs]
+        if any(c is None for c in counts):
+            return outputs   # scalar outputs: nothing to trim
+        excess = sum(counts) - len(ds)
+        if excess <= 0:
+            return outputs
+        keep = counts[-1] - excess
+        if keep <= 0:
+            return outputs[:-1]
+        outputs[-1] = jax.tree_util.tree_map(
+            lambda a: a[:keep] if getattr(a, "ndim", 0) > 0 else a,
+            outputs[-1])
         return outputs
 
     # -- finalization / results round-trip -------------------------------
@@ -623,10 +669,11 @@ class Trainer:
     # checkpointing
     # ------------------------------------------------------------------
 
-    def save_checkpoint(self, filepath: str) -> None:
-        """Collective: every process participates in the gather; only
-        global-zero writes (fsspec so GCS paths work on pods —
-        SURVEY.md §7 best-path/locality hazard)."""
+    def dump_checkpoint(self) -> dict:
+        """Assemble the full checkpoint dict.  Collective: every process
+        participates in the state gather (reference analog:
+        ``trainer.checkpoint_connector.dump_checkpoint()``, consumed by the
+        Tune checkpoint relay, tune.py:172)."""
         module = self.lightning_module
         ckpt = {
             "epoch": int(self.current_epoch),
@@ -643,8 +690,19 @@ class Trainer:
             module.on_save_checkpoint(ckpt)
         for cb in self.callbacks:
             cb.on_save_checkpoint(self, module, ckpt)
+        return ckpt
+
+    @staticmethod
+    def serialize_checkpoint(ckpt: dict) -> bytes:
+        return serialization.msgpack_serialize(ckpt)
+
+    def save_checkpoint(self, filepath: str) -> None:
+        """Collective: every process participates in the gather; only
+        global-zero writes (fsspec so GCS paths work on pods —
+        SURVEY.md §7 best-path/locality hazard)."""
+        ckpt = self.dump_checkpoint()
         if self.is_global_zero:
-            payload = serialization.msgpack_serialize(ckpt)
+            payload = self.serialize_checkpoint(ckpt)
             dirname = os.path.dirname(filepath)
             if dirname and "://" not in filepath:
                 os.makedirs(dirname, exist_ok=True)
@@ -688,6 +746,51 @@ class Trainer:
     @staticmethod
     def _now() -> float:
         return time.monotonic()
+
+
+def _deinterleave_global_batch(tree, w: int):
+    """Global fetched batch rows are process-major ([shard0; shard1; …]);
+    strided sharding means shard r holds dataset indices r, r+W, … — so
+    dataset order is the (position, shard) transpose."""
+    def fix(a):
+        if getattr(a, "ndim", 0) == 0 or a.shape[0] % w:
+            return a
+        lb = a.shape[0] // w
+        return a.reshape((w, lb) + a.shape[1:]).swapaxes(0, 1).reshape(
+            (w * lb,) + a.shape[1:])
+    return jax.tree_util.tree_map(fix, tree)
+
+
+class _ShardedStepCache:
+    """Lazily jit a (state, batch) step per batch *structure* with the
+    strategy's ``in_shardings``.
+
+    Eval/predict loaders may yield a different batch pytree than the
+    train loader the trainer compiled against (e.g. ``(x, y)`` vs ``x``),
+    so the jit — whose ``in_shardings`` must match the arg structure — is
+    built on first use per structure and cached."""
+
+    def __init__(self, fn, trainer, strategy):
+        self._fn = fn
+        self._trainer = trainer
+        self._strategy = strategy
+        self._cache: dict = {}
+
+    def __call__(self, state, batch):
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        key = (treedef, tuple(getattr(l, "ndim", 0) for l in leaves))
+        jitted = self._cache.get(key)
+        if jitted is None:
+            if self._trainer._mesh.devices.size > 1:
+                batch_sh = self._strategy.batch_shardings(
+                    self._trainer._mesh, batch)
+                jitted = jax.jit(
+                    self._fn,
+                    in_shardings=(self._trainer._state_shardings, batch_sh))
+            else:
+                jitted = jax.jit(self._fn)
+            self._cache[key] = jitted
+        return jitted(state, batch)
 
 
 def _peek_first_batch(loader):

@@ -185,12 +185,17 @@ class RayXlaPlugin(ExecutionPlugin):
         if self.platform:
             env["RLT_PLATFORM"] = self.platform
             env["JAX_PLATFORMS"] = self.platform
-        if self.platform == "cpu" and self.devices_per_worker:
-            flags = os.environ.get("XLA_FLAGS", "")
+        if self.platform == "cpu":
+            # each CPU worker gets exactly devices_per_worker virtual
+            # devices (default 1) — strip any inherited force flag (e.g.
+            # from a test harness) so the worker count is deterministic
+            n = self.devices_per_worker or 1
+            flags = " ".join(
+                f for f in os.environ.get("XLA_FLAGS", "").split()
+                if "xla_force_host_platform_device_count" not in f)
             env["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count="
-                f"{self.devices_per_worker}").strip()
-            env["RLT_NUM_LOCAL_DEVICES"] = str(self.devices_per_worker)
+                f"{flags} --xla_force_host_platform_device_count={n}").strip()
+            env["RLT_NUM_LOCAL_DEVICES"] = str(n)
         env.update(self.worker_env)
         return env
 

@@ -1,0 +1,70 @@
+"""Hyperparameter tuning: native Tune-capability subsystem.
+
+Reference surface (``ray_lightning/tune.py`` + the ``ray.tune`` API its
+examples consume): trial resources, report/checkpoint callbacks, and a
+``run`` entry point with search spaces and ASHA/PBT schedulers.  The
+reference delegates scheduling to Ray Tune; this framework ships its own
+local runner so a TPU pod needs no Ray, while the callbacks/resources
+also plug into real Ray Tune when it is installed (RAY_AVAILABLE).
+"""
+
+from ray_lightning_tpu.tune.integration import (
+    TrialResources,
+    TuneReportCallback,
+    TuneReportCheckpointCallback,
+    _TuneCheckpointCallback,
+    get_tune_resources,
+)
+from ray_lightning_tpu.tune.runner import (
+    ExperimentAnalysis,
+    Trial,
+    run,
+)
+from ray_lightning_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_lightning_tpu.tune.search import (
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_lightning_tpu.tune.session import (
+    checkpoint_dir,
+    get_trial_dir,
+    get_trial_id,
+    report,
+)
+
+#: parity with the reference's TUNE_INSTALLED guard (tune.py:13-27): the
+#: native tune subsystem is always available; this flag remains for
+#: user code written against the reference's pattern.
+TUNE_INSTALLED = True
+
+__all__ = [
+    "TrialResources",
+    "TuneReportCallback",
+    "TuneReportCheckpointCallback",
+    "get_tune_resources",
+    "ExperimentAnalysis",
+    "Trial",
+    "run",
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "PopulationBasedTraining",
+    "TrialScheduler",
+    "choice",
+    "grid_search",
+    "loguniform",
+    "randint",
+    "uniform",
+    "checkpoint_dir",
+    "get_trial_dir",
+    "get_trial_id",
+    "report",
+    "TUNE_INSTALLED",
+]

@@ -1,0 +1,249 @@
+"""Trainer↔Tune integration: trial resources + report/checkpoint relay.
+
+Reference parity (ray_lightning/tune.py in full):
+- ``get_tune_resources`` (tune.py:32-56) → per-trial resource bundles:
+  one head bundle for the trial driver + ``num_workers`` worker bundles,
+  expressed in TPU chips instead of GPUs.
+- ``TuneReportCallback`` (tune.py:59-134): on the configured trainer
+  event, rank 0 snapshots ``trainer.callback_metrics`` (skipping the
+  sanity check) and relays ``report(**metrics)`` to the *trial driver* —
+  through the worker→driver queue when training runs in actors, directly
+  when it runs in-process.
+- ``TuneReportCheckpointCallback`` (tune.py:180-236): additionally
+  streams the full checkpoint as bytes through the queue; the trial
+  driver writes it into ``tune.checkpoint_dir(step)`` (tune.py:161-178).
+
+The "relay the side-effect, not the call" pattern is preserved exactly:
+``report`` only works where the trial session lives, so workers enqueue
+zero-arg callables that the driver's ``process_results`` loop executes
+(SURVEY.md §3.3; util.py:47-52).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import warnings
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Union
+
+from ray_lightning_tpu.core.callbacks import Callback
+from ray_lightning_tpu.session import get_session
+from ray_lightning_tpu.tune import session as tune_session
+from ray_lightning_tpu.utils.imports import RAY_AVAILABLE
+
+_log = logging.getLogger(__name__)
+
+
+@dataclass
+class TrialResources:
+    """Per-trial resource bundles (PlacementGroupFactory analog).
+
+    ``bundles[0]`` is the trial-driver head (1 CPU, parity with
+    tune.py:50-53); the rest are worker bundles.  ``as_placement_group_
+    factory()`` converts to a real Ray PlacementGroupFactory when Ray is
+    installed.
+    """
+
+    bundles: list = field(default_factory=list)
+    strategy: str = "PACK"
+
+    @property
+    def head_cpus(self) -> float:
+        return self.bundles[0].get("CPU", 0) if self.bundles else 0
+
+    def as_placement_group_factory(self):
+        if not RAY_AVAILABLE:
+            raise ImportError("Ray is not installed.")
+        from ray.tune import PlacementGroupFactory
+        return PlacementGroupFactory(self.bundles, strategy=self.strategy)
+
+
+def get_tune_resources(
+    num_workers: int = 1,
+    num_cpus_per_worker: int = 1,
+    use_tpu: bool = False,
+    tpus_per_worker: int = 1,
+    resources_per_worker: Optional[dict] = None,
+    cpus_per_worker: Optional[int] = None,   # deprecated shim (tune.py:42-48)
+) -> TrialResources:
+    """Resources for one Tune trial running ``num_workers`` actors.
+
+    TPU chips replace GPUs in the bundle currency: a worker bundle is
+    ``{CPU: n, TPU: chips}`` — one bundle per TPU *host* actor.
+    """
+    if cpus_per_worker is not None:
+        warnings.warn(
+            "cpus_per_worker is deprecated; use num_cpus_per_worker",
+            DeprecationWarning, stacklevel=2)
+        num_cpus_per_worker = cpus_per_worker
+    resources = dict(resources_per_worker or {})
+    num_cpus_per_worker = resources.pop("CPU", num_cpus_per_worker)
+    if "TPU" in resources:
+        tpus = resources.pop("TPU")
+        use_tpu = tpus > 0
+        tpus_per_worker = tpus or tpus_per_worker
+    worker = {"CPU": num_cpus_per_worker, **resources}
+    if use_tpu:
+        worker["TPU"] = tpus_per_worker
+    head = {"CPU": 1}
+    return TrialResources(bundles=[head] + [dict(worker)] * num_workers,
+                          strategy="PACK")
+
+
+_EVENTS = ("validation_end", "train_epoch_end", "train_end", "batch_end")
+
+
+class _TuneCallbackBase(Callback):
+    """Event-dispatch base (reference TuneCallback(on=...) analog)."""
+
+    def __init__(self, on: Union[str, Sequence[str]] = "validation_end"):
+        if isinstance(on, str):
+            on = [on]
+        bad = [e for e in on if e not in _EVENTS]
+        if bad:
+            raise ValueError(f"Unknown events {bad}; options: {_EVENTS}")
+        self._on = set(on)
+
+    def _handle(self, trainer, module) -> None:
+        raise NotImplementedError
+
+    def _fire(self, event, trainer, module):
+        if event in self._on and not trainer.sanity_checking \
+                and trainer.is_global_zero:
+            self._handle(trainer, module)
+
+    def on_validation_end(self, trainer, module):
+        self._fire("validation_end", trainer, module)
+
+    def on_train_epoch_end(self, trainer, module):
+        self._fire("train_epoch_end", trainer, module)
+
+    def on_train_end(self, trainer, module):
+        self._fire("train_end", trainer, module)
+
+    def on_train_batch_end(self, trainer, module, outputs, batch, batch_idx):
+        self._fire("batch_end", trainer, module)
+
+    @staticmethod
+    def _relay(payload) -> None:
+        """Run ``payload`` where the trial session lives: enqueue to the
+        driver when inside an actor worker, else call directly."""
+        try:
+            get_session().put_queue(payload)
+            return
+        except ValueError:
+            pass
+        if tune_session.in_session():
+            payload()
+        else:
+            _log.warning(
+                "Tune callback fired outside a tune trial and outside a "
+                "worker queue; dropping report.")
+
+
+class TuneReportCallback(_TuneCallbackBase):
+    """Report trainer metrics to Tune (reference: tune.py:59-134).
+
+    ``metrics`` may be None (report everything), a list of metric names,
+    or a dict mapping the reported name → trainer metric name.
+    """
+
+    def __init__(self, metrics: Union[None, str, list, dict] = None,
+                 on: Union[str, Sequence[str]] = "validation_end"):
+        super().__init__(on)
+        if isinstance(metrics, str):
+            metrics = [metrics]
+        self._metrics = metrics
+
+    def _get_report_dict(self, trainer) -> Optional[dict]:
+        # tune.py:110-128 analog: snapshot callback_metrics, filter/rename
+        cbm = {k: float(v) for k, v in trainer.callback_metrics.items()}
+        if not self._metrics:
+            report = dict(cbm)
+        elif isinstance(self._metrics, dict):
+            report = {}
+            for out_name, src in self._metrics.items():
+                if src in cbm:
+                    report[out_name] = cbm[src]
+        else:
+            report = {k: cbm[k] for k in self._metrics if k in cbm}
+        if not report:
+            _log.warning(
+                "Metrics %s not found in trainer.callback_metrics %s; "
+                "skipping report.", self._metrics, sorted(cbm))
+            return None
+        return report
+
+    def _handle(self, trainer, module) -> None:
+        report = self._get_report_dict(trainer)
+        if report is None:
+            return
+        self._relay(_ReportPayload(report))
+
+
+class _ReportPayload:
+    """Picklable zero-arg callable executed on the trial driver."""
+
+    def __init__(self, metrics: dict):
+        self.metrics = metrics
+
+    def __call__(self):
+        tune_session.report(**self.metrics)
+
+
+class _CheckpointPayload:
+    """Write checkpoint bytes into the trial's checkpoint dir, driver-side
+    (tune.py:161-167 analog: worker bytes → driver fsspec write)."""
+
+    def __init__(self, blob: bytes, step: int, filename: str):
+        self.blob = blob
+        self.step = step
+        self.filename = filename
+
+    def __call__(self):
+        with tune_session.checkpoint_dir(self.step) as d:
+            with open(os.path.join(d, self.filename), "wb") as f:
+                f.write(self.blob)
+
+
+class _TuneCheckpointCallback(_TuneCallbackBase):
+    """Stream the full trainer checkpoint to the trial driver
+    (reference: tune.py:136-178)."""
+
+    def __init__(self, filename: str = "checkpoint",
+                 on: Union[str, Sequence[str]] = "validation_end"):
+        super().__init__(on)
+        self._filename = filename
+
+    def _fire(self, event, trainer, module):
+        # checkpoint assembly is collective (all ranks gather) — only the
+        # relay itself is rank-0-gated.
+        if event in self._on and not trainer.sanity_checking:
+            ckpt = trainer.dump_checkpoint()
+            if trainer.is_global_zero:
+                blob = trainer.serialize_checkpoint(ckpt)
+                self._relay(_CheckpointPayload(
+                    blob, trainer.global_step, self._filename))
+
+    def _handle(self, trainer, module) -> None:  # unused; _fire overridden
+        pass
+
+
+class TuneReportCheckpointCallback(_TuneCallbackBase):
+    """Checkpoint then report, so Tune associates the checkpoint with the
+    reported iteration (reference: tune.py:180-236, order at :234-236)."""
+
+    def __init__(self, metrics: Union[None, str, list, dict] = None,
+                 filename: str = "checkpoint",
+                 on: Union[str, Sequence[str]] = "validation_end"):
+        super().__init__(on)
+        self._checkpoint = _TuneCheckpointCallback(filename, on)
+        self._report = TuneReportCallback(metrics, on)
+
+    def _fire(self, event, trainer, module):
+        self._checkpoint._fire(event, trainer, module)
+        self._report._fire(event, trainer, module)
+
+    def _handle(self, trainer, module) -> None:
+        pass

@@ -1,0 +1,235 @@
+"""The trial runner: ``tune.run`` executed locally, no Ray required.
+
+Reference shape being reproduced (SURVEY.md §3.3): ``tune.run(train_fn,
+config, num_samples, scheduler, resources_per_trial)`` → per-trial driver
+runs ``train_fn(config)``, which builds a Trainer (possibly with a
+distributed plugin whose actors train remotely) and reports metrics /
+checkpoints through the session.  Returns an ``ExperimentAnalysis`` with
+``best_config`` / ``best_checkpoint`` / per-trial ``last_result``.
+
+Trials run in threads (``max_concurrent_trials``); the compute inside a
+trial lives either in-process (LocalPlugin SPMD) or in actor
+subprocesses (RayXlaPlugin), so threads are purely coordination.
+"""
+
+from __future__ import annotations
+
+import inspect
+import logging
+import os
+import threading
+import time
+import traceback
+from typing import Any, Callable, Optional
+
+from ray_lightning_tpu.tune.schedulers import (
+    CONTINUE, EXPLOIT, STOP, Decision, FIFOScheduler,
+    PopulationBasedTraining, TrialScheduler)
+from ray_lightning_tpu.tune.search import generate_variants
+from ray_lightning_tpu.tune.session import TrialSession, set_session
+
+_log = logging.getLogger(__name__)
+
+
+class _StopTrial(Exception):
+    pass
+
+
+class _ExploitTrial(Exception):
+    def __init__(self, config: dict, checkpoint: str):
+        self.config = config
+        self.checkpoint = checkpoint
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: dict, logdir: str):
+        self.trial_id = trial_id
+        self.config = config
+        self.logdir = logdir
+        self.status = "PENDING"
+        self.last_result: dict = {}
+        self.history: list[dict] = []
+        self.latest_checkpoint: Optional[str] = None
+        self.error: Optional[str] = None
+
+    def __repr__(self):
+        return f"Trial({self.trial_id}, {self.status})"
+
+
+class ExperimentAnalysis:
+    def __init__(self, trials: list[Trial], metric: Optional[str],
+                 mode: str):
+        self.trials = trials
+        self.default_metric = metric
+        self.default_mode = mode
+
+    # -- reference-surface accessors (ray.tune.ExperimentAnalysis) ------
+
+    @property
+    def results(self) -> dict[str, dict]:
+        return {t.trial_id: t.last_result for t in self.trials}
+
+    def get_best_trial(self, metric: Optional[str] = None,
+                       mode: Optional[str] = None) -> Optional[Trial]:
+        metric = metric or self.default_metric
+        mode = mode or self.default_mode
+        sign = -1.0 if mode == "min" else 1.0
+        best, best_v = None, None
+        for t in self.trials:
+            if t.status == "ERROR" or metric not in t.last_result:
+                continue
+            v = sign * float(t.last_result[metric])
+            if best_v is None or v > best_v:
+                best, best_v = t, v
+        return best
+
+    @property
+    def best_trial(self) -> Optional[Trial]:
+        return self.get_best_trial()
+
+    @property
+    def best_config(self) -> Optional[dict]:
+        t = self.best_trial
+        return t.config if t else None
+
+    @property
+    def best_checkpoint(self) -> Optional[str]:
+        t = self.best_trial
+        return t.latest_checkpoint if t else None
+
+    @property
+    def best_result(self) -> Optional[dict]:
+        t = self.best_trial
+        return t.last_result if t else None
+
+
+def _accepts_checkpoint_dir(fn: Callable) -> bool:
+    try:
+        return "checkpoint_dir" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+def run(
+    trainable: Callable,
+    config: Optional[dict] = None,
+    *,
+    num_samples: int = 1,
+    scheduler: Optional[TrialScheduler] = None,
+    metric: Optional[str] = None,
+    mode: str = "min",
+    stop: Optional[dict] = None,
+    resources_per_trial: Any = None,   # accepted for parity; local runner
+    local_dir: Optional[str] = None,   # schedules by max_concurrent only
+    name: Optional[str] = None,
+    max_concurrent_trials: Optional[int] = None,
+    fail_fast: bool = False,
+    raise_on_failed_trial: bool = True,
+    seed: int = 0,
+    verbose: int = 1,
+) -> ExperimentAnalysis:
+    """Run ``num_samples`` trials of ``trainable`` over ``config``.
+
+    ``trainable(config)`` or ``trainable(config, checkpoint_dir=None)``
+    (the latter enables PBT exploit restores, reference-PBT contract).
+    """
+    scheduler = scheduler or FIFOScheduler(metric or "loss", mode)
+    if metric is None:
+        metric = scheduler.metric
+    local_dir = local_dir or os.path.join(os.getcwd(), "rlt_tune")
+    exp_name = name or f"exp_{int(time.time())}"
+    exp_dir = os.path.join(local_dir, exp_name)
+    os.makedirs(exp_dir, exist_ok=True)
+
+    variants = generate_variants(dict(config or {}), num_samples, seed)
+    trials = []
+    for i, cfg in enumerate(variants):
+        tid = f"trial_{i:05d}"
+        logdir = os.path.join(exp_dir, tid)
+        os.makedirs(logdir, exist_ok=True)
+        trials.append(Trial(tid, cfg, logdir))
+
+    stop = dict(stop or {})
+    takes_ckpt = _accepts_checkpoint_dir(trainable)
+    errors: list[BaseException] = []
+    errors_lock = threading.Lock()
+
+    if max_concurrent_trials is None:
+        # PBT is population-based: the population must coexist.
+        max_concurrent_trials = (
+            len(trials) if isinstance(scheduler, PopulationBasedTraining)
+            else 1)
+    sem = threading.Semaphore(max(1, max_concurrent_trials))
+
+    def on_report(trial: Trial, metrics: dict) -> None:
+        trial.last_result = dict(metrics)
+        trial.history.append(dict(metrics))
+        it = int(metrics.get("training_iteration", 0))
+        stop_it = stop.get("training_iteration")
+        decision = scheduler.on_result(trial, metrics)
+        if decision.action == EXPLOIT:
+            trial.config = dict(decision.config)
+            raise _ExploitTrial(decision.config, decision.checkpoint)
+        if decision.action == STOP or (stop_it and it >= stop_it):
+            raise _StopTrial()
+        for key, bound in stop.items():
+            if key in metrics and key != "training_iteration" \
+                    and float(metrics[key]) >= float(bound):
+                raise _StopTrial()
+
+    def run_trial(trial: Trial) -> None:
+        with sem:
+            trial.status = "RUNNING"
+            session = TrialSession(trial, on_report)
+            set_session(session)
+            restore_from: Optional[str] = None
+            try:
+                while True:
+                    try:
+                        if takes_ckpt:
+                            trainable(dict(trial.config),
+                                      checkpoint_dir=restore_from)
+                        else:
+                            trainable(dict(trial.config))
+                        trial.status = "TERMINATED"
+                        return
+                    except _StopTrial:
+                        trial.status = "TERMINATED"
+                        return
+                    except _ExploitTrial as e:
+                        if not takes_ckpt:
+                            _log.warning(
+                                "PBT exploit requested but %s has no "
+                                "checkpoint_dir parameter; continuing "
+                                "without restore.", trainable)
+                        restore_from = e.checkpoint
+                        _log.info("%s exploiting: restart from %s",
+                                  trial.trial_id, e.checkpoint)
+                        continue  # restart with mutated config
+            except BaseException as e:          # noqa: BLE001
+                trial.status = "ERROR"
+                trial.error = traceback.format_exc()
+                with errors_lock:
+                    errors.append(e)
+                if verbose:
+                    _log.error("%s failed:\n%s", trial.trial_id, trial.error)
+            finally:
+                scheduler.on_trial_complete(trial)
+                set_session(None)
+
+    threads = [threading.Thread(target=run_trial, args=(t,), daemon=True)
+               for t in trials]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+
+    if errors and (fail_fast or raise_on_failed_trial):
+        # ray.tune parity: any failed trial raises by default, so partial
+        # failures can't be misread as complete sweeps
+        failed = [t.trial_id for t in trials if t.status == "ERROR"]
+        raise RuntimeError(
+            f"{len(failed)} trial(s) failed: {failed}. First error "
+            f"below; pass raise_on_failed_trial=False to get a partial "
+            f"ExperimentAnalysis instead.") from errors[0]
+    return ExperimentAnalysis(trials, metric, mode)

@@ -1,0 +1,167 @@
+"""Trial schedulers: FIFO, ASHA (async successive halving), PBT.
+
+The reference defers scheduling wholesale to Ray Tune (SURVEY.md §3.3:
+"Tune scheduler (ASHA/PBT/...) consumes reports, manages trials —
+external").  Since this framework must stand alone on a TPU pod without
+Ray installed, the two schedulers the reference's docs/examples lean on
+are implemented natively.  Decisions are made synchronously inside
+``report`` — the trial's thread blocks on its own decision, trials never
+preempt each other mid-step.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+@dataclass
+class Decision:
+    action: str = CONTINUE
+    # for EXPLOIT (PBT): restart from this checkpoint with this config
+    config: Optional[dict] = None
+    checkpoint: Optional[str] = None
+
+
+EXPLOIT = "EXPLOIT"
+
+
+class TrialScheduler:
+    """Base: sees every report; decides the trial's fate."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min"):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self.metric = metric
+        self.mode = mode
+        self._lock = threading.Lock()
+
+    def _score(self, metrics: dict) -> Optional[float]:
+        v = metrics.get(self.metric)
+        if v is None:
+            return None
+        v = float(v)
+        return -v if self.mode == "min" else v  # higher is better
+
+    def on_result(self, trial, metrics: dict) -> Decision:
+        return Decision(CONTINUE)
+
+    def on_trial_complete(self, trial) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion."""
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous Successive Halving.
+
+    Rungs at ``grace_period * reduction_factor**k`` (in
+    ``training_iteration`` units).  At each rung a trial continues only if
+    its score is in the top ``1/reduction_factor`` of results recorded at
+    that rung so far — the asynchronous variant: early trials pass through
+    until enough competitors exist.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4):
+        super().__init__(metric, mode)
+        self.max_t = max_t
+        self.grace_period = max(1, grace_period)
+        self.rf = max(2, reduction_factor)
+        self._rungs: dict[int, list[float]] = {}
+        self._milestones = []
+        t = self.grace_period
+        while t < max_t:
+            self._milestones.append(t)
+            t *= self.rf
+
+    def on_result(self, trial, metrics: dict) -> Decision:
+        it = int(metrics.get("training_iteration", 0))
+        score = self._score(metrics)
+        if score is None:
+            return Decision(CONTINUE)
+        if it >= self.max_t:
+            return Decision(STOP)
+        with self._lock:
+            for ms in self._milestones:
+                if it == ms:
+                    rung = self._rungs.setdefault(ms, [])
+                    rung.append(score)
+                    k = max(1, len(rung) // self.rf)
+                    cutoff = sorted(rung, reverse=True)[k - 1]
+                    if score < cutoff:
+                        return Decision(STOP)
+        return Decision(CONTINUE)
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT: every ``perturbation_interval`` iterations, bottom-quantile
+    trials clone a top-quantile trial's latest checkpoint and continue
+    with a perturbed copy of its config.
+
+    ``hyperparam_mutations`` maps config key → list of values or a
+    ``Domain``; perturbation picks a neighbor / resamples.  Requires the
+    trainable to save checkpoints via ``tune.checkpoint_dir`` (the
+    TuneReportCheckpointCallback does this).
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[dict] = None,
+                 quantile_fraction: float = 0.25, seed: int = 0):
+        super().__init__(metric, mode)
+        self.interval = max(1, perturbation_interval)
+        self.mutations = dict(hyperparam_mutations or {})
+        self.quantile = quantile_fraction
+        self._rng = random.Random(seed)
+        #: trial_id -> (score, config, checkpoint)
+        self._population: dict[str, tuple[float, dict, Optional[str]]] = {}
+
+    def _perturb(self, config: dict) -> dict:
+        from ray_lightning_tpu.tune.search import Domain
+        out = dict(config)
+        for key, mut in self.mutations.items():
+            if isinstance(mut, Domain):
+                out[key] = mut.sample(
+                    __import__("numpy").random.default_rng(
+                        self._rng.randrange(2**31)))
+            elif isinstance(mut, list):
+                out[key] = self._rng.choice(mut)
+            elif callable(mut):
+                out[key] = mut()
+            elif isinstance(out.get(key), (int, float)):
+                factor = self._rng.choice([0.8, 1.2])
+                out[key] = type(out[key])(out[key] * factor)
+        return out
+
+    def on_result(self, trial, metrics: dict) -> Decision:
+        it = int(metrics.get("training_iteration", 0))
+        score = self._score(metrics)
+        if score is None:
+            return Decision(CONTINUE)
+        with self._lock:
+            self._population[trial.trial_id] = (
+                score, dict(trial.config), trial.latest_checkpoint)
+            if it % self.interval != 0 or len(self._population) < 2:
+                return Decision(CONTINUE)
+            ranked = sorted(self._population.items(),
+                            key=lambda kv: kv[1][0], reverse=True)
+            n = len(ranked)
+            k = max(1, int(n * self.quantile))
+            bottom_ids = {tid for tid, _ in ranked[-k:]}
+            if trial.trial_id not in bottom_ids or n <= k:
+                return Decision(CONTINUE)
+            donor_id, (dscore, dconfig, dckpt) = ranked[
+                self._rng.randrange(min(k, n - k))]
+            if donor_id == trial.trial_id or dckpt is None:
+                return Decision(CONTINUE)
+            return Decision(EXPLOIT, config=self._perturb(dconfig),
+                            checkpoint=dckpt)

@@ -1,0 +1,88 @@
+"""Per-trial session: the process/thread-local context that makes
+``report`` / ``checkpoint_dir`` work inside a running trial.
+
+Reference behavior being reproduced: ``tune.report`` and
+``tune.checkpoint_dir`` only work in the process Tune launched
+(reference: tune.py:130-134, :161-178 route them through the queue so
+they execute on the trial driver).  Here the session is thread-local —
+the local runner executes each trial in its own thread — and the
+framework's distributed plugins relay worker-side calls to the trial
+thread through the worker→driver queue exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Any, Optional
+
+_local = threading.local()
+
+
+class TrialSession:
+    """Live context of one running trial."""
+
+    def __init__(self, trial, on_report):
+        self.trial = trial
+        self._on_report = on_report
+        self._step = 0
+
+    def report(self, **metrics) -> None:
+        self._step += 1
+        metrics = dict(metrics)
+        metrics.setdefault("training_iteration", self._step)
+        self._on_report(self.trial, metrics)
+
+    @contextlib.contextmanager
+    def checkpoint_dir(self, step: int):
+        """Directory for this trial's checkpoint at ``step`` (parity with
+        ``tune.checkpoint_dir``, which the reference writes into via
+        fsspec, tune.py:161-167)."""
+        path = os.path.join(self.trial.logdir, f"checkpoint_{step:06d}")
+        os.makedirs(path, exist_ok=True)
+        yield path
+        self.trial.latest_checkpoint = path
+
+
+def _get() -> Optional[TrialSession]:
+    return getattr(_local, "session", None)
+
+
+def set_session(session: Optional[TrialSession]) -> None:
+    _local.session = session
+
+
+def in_session() -> bool:
+    return _get() is not None
+
+
+def report(_metrics: Optional[dict] = None, **metrics) -> None:
+    """Report metrics for the current trial (``tune.report`` analog)."""
+    s = _get()
+    if s is None:
+        raise RuntimeError(
+            "tune.report() called outside a tune trial; run this function "
+            "via ray_lightning_tpu.tune.run().")
+    merged = dict(_metrics or {})
+    merged.update(metrics)
+    s.report(**merged)
+
+
+@contextlib.contextmanager
+def checkpoint_dir(step: int):
+    s = _get()
+    if s is None:
+        raise RuntimeError("tune.checkpoint_dir() outside a tune trial.")
+    with s.checkpoint_dir(step) as path:
+        yield path
+
+
+def get_trial_id() -> str:
+    s = _get()
+    return s.trial.trial_id if s else "default"
+
+
+def get_trial_dir() -> Optional[str]:
+    s = _get()
+    return s.trial.logdir if s else None

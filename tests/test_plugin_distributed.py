@@ -1,0 +1,232 @@
+"""Distributed-plugin tests over the built-in subprocess actor backend
+(reference: tests/test_ddp.py — same pyramid, CPU workers standing in for
+TPU hosts the way gloo stood in for NCCL).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import (
+    Callback,
+    RayXlaPlugin,
+    RayXlaShardedPlugin,
+    Trainer,
+)
+from ray_lightning_tpu.models import BoringModel, LightningMNISTClassifier
+
+from tests.utils import get_trainer, load_test, predict_test, train_test
+
+
+def cpu_plugin(num_workers=2, **kw):
+    return RayXlaPlugin(num_workers=num_workers, platform="cpu", **kw)
+
+
+# -- constructor / resource parsing (test_ddp.py:136-174 parity) ----------
+
+def test_resources_per_worker_precedence():
+    p = RayXlaPlugin(num_workers=2, num_cpus_per_worker=8,
+                     resources_per_worker={"CPU": 3, "TPU": 4, "extra": 1})
+    assert p.num_cpus_per_worker == 3
+    assert p.use_tpu and p.devices_per_worker == 4
+    assert p.additional_resources == {"extra": 1}
+    res = p._worker_resources()
+    assert res == {"CPU": 3, "extra": 1, "TPU": 4}
+
+
+def test_invalid_num_workers():
+    with pytest.raises(ValueError):
+        RayXlaPlugin(num_workers=0)
+
+
+def test_plugin_pickle_drops_handles():
+    import pickle
+    p = cpu_plugin()
+    p._workers = ["sentinel"]
+    q = pickle.loads(pickle.dumps(p))
+    assert q._workers == []
+    assert q.num_workers == 2
+
+
+# -- rank topology (test_ddp.py:78-112 fake-node parity) ------------------
+
+def test_assign_local_ranks_two_nodes():
+    info = [{"ip": "1"}, {"ip": "2"}, {"ip": "1"}, {"ip": "2"}]
+    ranks = RayXlaPlugin._assign_local_ranks(info)
+    # node "1" gets global ranks 0,2; node "2" gets 1,3
+    assert ranks[0] == (0, 0)
+    assert ranks[2] == (0, 1)
+    assert ranks[1] == (1, 0)
+    assert ranks[3] == (1, 1)
+
+
+# -- end-to-end train/load/predict × worker counts (test_ddp.py) ----------
+
+@pytest.mark.parametrize("num_workers", [1, 2])
+def test_train(tmp_path, seed, num_workers):
+    trainer = get_trainer(str(tmp_path),
+                          plugins=[cpu_plugin(num_workers)])
+    train_test(trainer, BoringModel())
+
+
+@pytest.mark.parametrize("num_workers", [2])
+def test_load(tmp_path, seed, num_workers):
+    trainer = get_trainer(str(tmp_path), plugins=[cpu_plugin(num_workers)])
+    load_test(trainer, BoringModel())
+
+
+@pytest.mark.slow
+def test_predict(tmp_path, seed):
+    trainer = get_trainer(str(tmp_path), max_epochs=4,
+                          limit_train_batches=16, limit_val_batches=2,
+                          plugins=[cpu_plugin(2)])
+    predict_test(trainer, LightningMNISTClassifier(
+        config={"batch_size": 32}))
+
+
+def test_metrics_and_progress_roundtrip(tmp_path, seed):
+    """callback_metrics / epoch / global_step propagate driver-side after
+    remote training (ray_ddp.py:366-370 analog)."""
+    trainer = get_trainer(str(tmp_path), max_epochs=2, checkpoint=False,
+                          plugins=[cpu_plugin(2)])
+    trainer.fit(BoringModel())
+    assert trainer.current_epoch == 2
+    assert trainer.global_step == 20
+    assert np.isfinite(trainer.callback_metrics["loss"])
+    assert np.isfinite(trainer.callback_metrics["val_loss"])
+
+
+def test_best_model_path_propagates(tmp_path, seed):
+    trainer = get_trainer(str(tmp_path), plugins=[cpu_plugin(2)])
+    trainer.fit(BoringModel())
+    best = trainer.checkpoint_callback.best_model_path
+    assert best and os.path.exists(best)
+
+
+def test_init_hook_runs_on_workers(tmp_path, seed):
+    """init_hook executes once per worker before training
+    (examples/ray_ddp_tune.py:22-25 parity)."""
+    marker_dir = str(tmp_path / "markers")
+    os.makedirs(marker_dir, exist_ok=True)
+
+    def hook():
+        open(os.path.join(os.environ["RLT_MARKER_DIR"],
+                          f"pid_{os.getpid()}"), "w").close()
+
+    plugin = cpu_plugin(2, init_hook=hook,
+                        worker_env={"RLT_MARKER_DIR": marker_dir})
+    trainer = get_trainer(str(tmp_path), checkpoint=False,
+                          plugins=[plugin])
+    trainer.fit(BoringModel())
+    assert len(os.listdir(marker_dir)) == 2
+
+
+def test_worker_env_propagation(tmp_path, seed):
+    """Env vars reach workers (set_env_vars parity, ray_ddp.py:206-219)
+    asserted *inside* the remote worker via callback — the reference's
+    assertion-via-callback idiom (test_ddp.py:184-204)."""
+
+    class AssertEnv(Callback):
+        def on_train_start(self, trainer, module):
+            assert os.environ.get("RLT_CUSTOM") == "42"
+            assert int(os.environ["RLT_NUM_PROCESSES"]) == 2
+
+    trainer = get_trainer(str(tmp_path), checkpoint=False,
+                          callbacks=[AssertEnv()],
+                          plugins=[cpu_plugin(2, worker_env={
+                              "RLT_CUSTOM": "42"})])
+    trainer.fit(BoringModel())
+
+
+def test_world_info_inside_workers(tmp_path, seed):
+    """world_size/global_rank visible to remote code; failure inside the
+    worker surfaces on the driver (util.py:61-63 error parity)."""
+
+    class AssertWorld(Callback):
+        def on_train_start(self, trainer, module):
+            assert trainer.world_size == 2
+            assert trainer.global_rank in (0, 1)
+
+    trainer = get_trainer(str(tmp_path), checkpoint=False,
+                          callbacks=[AssertWorld()],
+                          plugins=[cpu_plugin(2)])
+    trainer.fit(BoringModel())
+
+
+def test_worker_failure_raises_on_driver(tmp_path, seed):
+    class Boom(Callback):
+        def on_train_start(self, trainer, module):
+            raise RuntimeError("worker exploded")
+
+    trainer = get_trainer(str(tmp_path), checkpoint=False,
+                          callbacks=[Boom()], plugins=[cpu_plugin(2)])
+    with pytest.raises(Exception, match="worker exploded"):
+        trainer.fit(BoringModel())
+
+
+def test_actors_torn_down(tmp_path, seed):
+    plugin = cpu_plugin(2)
+    trainer = get_trainer(str(tmp_path), checkpoint=False, plugins=[plugin])
+    trainer.fit(BoringModel())
+    assert plugin._workers == []   # ray.kill + clear parity (ray_ddp.py:383-386)
+
+
+def test_evaluate_without_fit(tmp_path, seed):
+    """trainer.test() without fit (test_ddp.py:230-237 parity)."""
+    trainer = get_trainer(str(tmp_path), checkpoint=False,
+                          plugins=[cpu_plugin(2)])
+    out = trainer.test(BoringModel())
+    assert "test_loss" in out[0]
+
+
+# -- sharded plugin (test_ddp_sharded.py parity) --------------------------
+
+def test_sharded_train(tmp_path, seed):
+    trainer = get_trainer(str(tmp_path), checkpoint=False,
+                          plugins=[RayXlaShardedPlugin(num_workers=2,
+                                                       platform="cpu")])
+    train_test(trainer, BoringModel())
+
+
+def test_sharded_strategy_resolved():
+    p = RayXlaShardedPlugin(num_workers=2, platform="cpu")
+    assert p.strategy.name == "zero1"
+
+
+@pytest.mark.slow
+def test_sharded_resume_fewer_workers(tmp_path, seed):
+    """Checkpoint from 2 sharded workers resumes on 1 worker
+    (test_ddp_sharded.py:119-138 parity): checkpoints hold the full
+    gathered state, so resharding is just re-distribution."""
+    module = BoringModel()
+    trainer = get_trainer(str(tmp_path), max_epochs=1,
+                          plugins=[RayXlaShardedPlugin(num_workers=2,
+                                                       platform="cpu")])
+    trainer.fit(module)
+    ckpt = trainer.checkpoint_callback.best_model_path
+    assert ckpt and os.path.exists(ckpt)
+
+    module2 = BoringModel()
+    trainer2 = get_trainer(str(tmp_path / "resume"), max_epochs=2,
+                           checkpoint=False,
+                           plugins=[RayXlaShardedPlugin(num_workers=1,
+                                                        platform="cpu")])
+    trainer2.fit(module2, ckpt_path=ckpt)
+    assert trainer2.current_epoch == 2
+
+
+def test_checkpoint_equals_trained_weights(tmp_path, seed):
+    """Saved checkpoint state equals the round-tripped weights
+    (test_ddp_sharded.py:47-64 parity)."""
+    module = BoringModel()
+    trainer = get_trainer(str(tmp_path), plugins=[cpu_plugin(2)])
+    trainer.fit(module)
+    ckpt = Trainer.load_checkpoint_dict(
+        trainer.checkpoint_callback.best_model_path)
+    from flax import serialization
+    trained = module._trained_variables["params"]
+    saved = serialization.from_state_dict(trained, ckpt["state"]["params"])
+    for a, b in zip(np.asarray(list(saved.values())[0]["kernel"]).ravel()[:3],
+                    np.asarray(list(trained.values())[0]["kernel"]).ravel()[:3]):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
