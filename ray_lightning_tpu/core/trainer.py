@@ -45,6 +45,7 @@ from ray_lightning_tpu.core.steps import (
     build_train_step,
 )
 from ray_lightning_tpu.parallel.gather import fetch_tree
+from ray_lightning_tpu.parallel.mesh import set_current_mesh
 from ray_lightning_tpu.parallel.strategy import resolve_strategy
 from ray_lightning_tpu.utils.seed import reset_seed, seed_everything
 
@@ -269,6 +270,7 @@ class Trainer:
                       else None)
         self._mesh = strategy.build_mesh(self.plugin.local_devices(),
                                          batch_hint=batch_hint)
+        set_current_mesh(self._mesh)  # for mesh-aware ops (ring attention)
         self._build_compiled(module, example_batch, strategy)
         self._init_state(module, example_batch, strategy, ckpt_path)
 
@@ -286,6 +288,7 @@ class Trainer:
                 cb.on_exception(self, module, e)
             raise
         finally:
+            set_current_mesh(None)
             for cb in self.callbacks:
                 cb.teardown(self, module, stage)
         return result

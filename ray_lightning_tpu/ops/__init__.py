@@ -1,0 +1,11 @@
+"""TPU Pallas kernels for the hot ops.
+
+The reference has no custom kernels (its compute path is torch/CUDA via
+DistributedDataParallel); here the hot attention op gets a hand-written
+TPU kernel where XLA's generic fusion isn't enough (long sequences whose
+full [T, T] score matrix would blow HBM).
+"""
+
+from ray_lightning_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

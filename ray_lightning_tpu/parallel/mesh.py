@@ -13,6 +13,7 @@ them last, which keeps XLA collectives on-slice.
 from __future__ import annotations
 
 import math
+import threading
 from typing import Sequence
 
 import jax
@@ -68,3 +69,19 @@ def mesh_axis_size(mesh: Mesh, *names: str) -> int:
         if n in mesh.axis_names:
             total *= mesh.shape[n]
     return total
+
+
+# The trainer publishes its mesh here so mesh-aware ops traced *inside*
+# its jitted step (ring attention's shard_map, parallel/ring.py) can
+# reach it without threading a handle through the flax module tree.
+# Thread-local because concurrent tune trials each run a Trainer in
+# their own thread (tune/runner.py) with distinct meshes.
+_MESH_TLS = threading.local()
+
+
+def set_current_mesh(mesh: Mesh | None) -> None:
+    _MESH_TLS.mesh = mesh
+
+
+def get_current_mesh() -> Mesh | None:
+    return getattr(_MESH_TLS, "mesh", None)

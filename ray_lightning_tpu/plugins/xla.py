@@ -42,6 +42,7 @@ from ray_lightning_tpu.plugins.base import ExecutionPlugin
 from ray_lightning_tpu.parallel.strategy import resolve_strategy
 from ray_lightning_tpu.session import init_session, reset_session
 from ray_lightning_tpu.util import process_results
+from ray_lightning_tpu.utils.platform import host_device_count_flags
 from ray_lightning_tpu.utils.seed import SEED_ENV_VAR
 from ray_lightning_tpu.utils.states import load_state_stream, to_state_stream
 
@@ -187,14 +188,9 @@ class RayXlaPlugin(ExecutionPlugin):
             env["JAX_PLATFORMS"] = self.platform
         if self.platform == "cpu":
             # each CPU worker gets exactly devices_per_worker virtual
-            # devices (default 1) — strip any inherited force flag (e.g.
-            # from a test harness) so the worker count is deterministic
+            # devices (default 1)
             n = self.devices_per_worker or 1
-            flags = " ".join(
-                f for f in os.environ.get("XLA_FLAGS", "").split()
-                if "xla_force_host_platform_device_count" not in f)
-            env["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count={n}").strip()
+            env["XLA_FLAGS"] = host_device_count_flags(n)
             env["RLT_NUM_LOCAL_DEVICES"] = str(n)
         env.update(self.worker_env)
         return env

@@ -117,7 +117,7 @@ def run(
     num_samples: int = 1,
     scheduler: Optional[TrialScheduler] = None,
     metric: Optional[str] = None,
-    mode: str = "min",
+    mode: Optional[str] = None,
     stop: Optional[dict] = None,
     resources_per_trial: Any = None,   # accepted for parity; local runner
     local_dir: Optional[str] = None,   # schedules by max_concurrent only
@@ -133,9 +133,13 @@ def run(
     ``trainable(config)`` or ``trainable(config, checkpoint_dir=None)``
     (the latter enables PBT exploit restores, reference-PBT contract).
     """
-    scheduler = scheduler or FIFOScheduler(metric or "loss", mode)
+    scheduler = scheduler or FIFOScheduler(metric or "loss", mode or "min")
+    # metric/mode default from the scheduler as one unit, so analysis
+    # ranking agrees with the scheduling direction
     if metric is None:
         metric = scheduler.metric
+    if mode is None:
+        mode = scheduler.mode
     local_dir = local_dir or os.path.join(os.getcwd(), "rlt_tune")
     exp_name = name or f"exp_{int(time.time())}"
     exp_dir = os.path.join(local_dir, exp_name)
@@ -153,6 +157,7 @@ def run(
     takes_ckpt = _accepts_checkpoint_dir(trainable)
     errors: list[BaseException] = []
     errors_lock = threading.Lock()
+    abort = threading.Event()  # fail_fast: first error stops the sweep
 
     if max_concurrent_trials is None:
         # PBT is population-based: the population must coexist.
@@ -164,6 +169,8 @@ def run(
     def on_report(trial: Trial, metrics: dict) -> None:
         trial.last_result = dict(metrics)
         trial.history.append(dict(metrics))
+        if abort.is_set():
+            raise _StopTrial()
         it = int(metrics.get("training_iteration", 0))
         stop_it = stop.get("training_iteration")
         decision = scheduler.on_result(trial, metrics)
@@ -179,6 +186,8 @@ def run(
 
     def run_trial(trial: Trial) -> None:
         with sem:
+            if abort.is_set():
+                return  # fail_fast tripped; leave trial PENDING
             trial.status = "RUNNING"
             session = TrialSession(trial, on_report)
             set_session(session)
@@ -211,6 +220,8 @@ def run(
                 trial.error = traceback.format_exc()
                 with errors_lock:
                     errors.append(e)
+                if fail_fast:
+                    abort.set()
                 if verbose:
                     _log.error("%s failed:\n%s", trial.trial_id, trial.error)
             finally:

@@ -16,6 +16,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+import numpy as np
+
 CONTINUE = "CONTINUE"
 STOP = "STOP"
 
@@ -77,6 +79,10 @@ class ASHAScheduler(TrialScheduler):
         self.grace_period = max(1, grace_period)
         self.rf = max(2, reduction_factor)
         self._rungs: dict[int, list[float]] = {}
+        #: milestone -> trial_ids already evaluated there (a trial hits
+        #: each rung once, at its first report at-or-past the milestone —
+        #: reports need not land exactly on milestone iterations)
+        self._recorded: dict[int, set[str]] = {}
         self._milestones = []
         t = self.grace_period
         while t < max_t:
@@ -92,13 +98,18 @@ class ASHAScheduler(TrialScheduler):
             return Decision(STOP)
         with self._lock:
             for ms in self._milestones:
-                if it == ms:
-                    rung = self._rungs.setdefault(ms, [])
-                    rung.append(score)
-                    k = max(1, len(rung) // self.rf)
-                    cutoff = sorted(rung, reverse=True)[k - 1]
-                    if score < cutoff:
-                        return Decision(STOP)
+                if it < ms:
+                    break
+                seen = self._recorded.setdefault(ms, set())
+                if trial.trial_id in seen:
+                    continue
+                seen.add(trial.trial_id)
+                rung = self._rungs.setdefault(ms, [])
+                rung.append(score)
+                k = max(1, len(rung) // self.rf)
+                cutoff = sorted(rung, reverse=True)[k - 1]
+                if score < cutoff:
+                    return Decision(STOP)
         return Decision(CONTINUE)
 
 
@@ -131,8 +142,7 @@ class PopulationBasedTraining(TrialScheduler):
         for key, mut in self.mutations.items():
             if isinstance(mut, Domain):
                 out[key] = mut.sample(
-                    __import__("numpy").random.default_rng(
-                        self._rng.randrange(2**31)))
+                    np.random.default_rng(self._rng.randrange(2**31)))
             elif isinstance(mut, list):
                 out[key] = self._rng.choice(mut)
             elif callable(mut):

@@ -1,0 +1,20 @@
+"""Platform/env helpers shared by the plugins and driver entry points."""
+
+from __future__ import annotations
+
+import os
+
+_FORCE_FLAG = "xla_force_host_platform_device_count"
+
+
+def host_device_count_flags(n: int, base_flags: str | None = None) -> str:
+    """XLA_FLAGS value with exactly one ``--{_FORCE_FLAG}={n}``.
+
+    Strips any inherited copy of the flag (e.g. from a test harness)
+    first, so the virtual-device count is deterministic.
+    """
+    base = (os.environ.get("XLA_FLAGS", "")
+            if base_flags is None else base_flags)
+    flags = [f for f in base.split() if _FORCE_FLAG not in f]
+    flags.append(f"--{_FORCE_FLAG}={n}")
+    return " ".join(flags).strip()
