@@ -518,8 +518,14 @@ class Trainer:
             self.logged_metrics[k] = float(arr[-1])
         self._epoch_metric_acc = {}
 
-    def _log_host_metric(self, name: str, value) -> None:
+    def log_metric(self, name: str, value) -> None:
+        """Record a host-side scalar into ``callback_metrics`` (public
+        entry point for callbacks; with distributed plugins rank-0's
+        metrics ride the normal result relay back to the driver)."""
         self.callback_metrics[name] = float(np.asarray(value))
+
+    # internal alias kept for module-side logging paths
+    _log_host_metric = log_metric
 
     # -- evaluation ------------------------------------------------------
 

@@ -1,0 +1,10 @@
+"""Runnable example scripts (reference: ray_lightning/examples/*.py).
+
+The actor-based scripts expose ``--num-workers``, ``--use-tpu`` and
+(where applicable) ``--tune`` / ``--address`` CLI flags, matching the
+reference's example CLI surface (examples/ray_ddp_example.py:118-173);
+the single-host SPMD script exposes mesh-axis flags instead.  All of
+them support ``--smoke-test``.
+``--smoke-test`` downsizes to one epoch / few batches on CPU workers so
+the scripts double as CI smoke tests (reference test.yaml:95-103).
+"""

@@ -1,0 +1,129 @@
+"""MNIST data-parallel training, optionally as a Tune sweep.
+
+Reference: examples/ray_ddp_example.py (MNISTClassifier + train_mnist /
+tune_mnist + CLI :118-173).  Same shape here with ``RayXlaPlugin``
+workers: the driver builds the module and Trainer; actors run the
+compiled SPMD step; Tune trials relay metrics through the worker→driver
+queue (SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from ray_lightning_tpu import Trainer, RayXlaPlugin
+from ray_lightning_tpu import tune
+from ray_lightning_tpu.models import LightningMNISTClassifier
+from ray_lightning_tpu.tune import (
+    TuneReportCallback,
+    get_tune_resources,
+)
+
+
+def train_mnist(config: dict,
+                data_dir: str = "",
+                num_epochs: int = 10,
+                num_workers: int = 1,
+                use_tpu: bool = False,
+                platform: str | None = None,
+                callbacks: list | None = None,
+                limit_train_batches: int | None = None,
+                limit_val_batches: int | None = None) -> Trainer:
+    """Train the MNIST classifier once (train_mnist analog,
+    examples/ray_ddp_example.py:41-58)."""
+    model = LightningMNISTClassifier(config, data_dir)
+    plugin = RayXlaPlugin(num_workers=num_workers, use_tpu=use_tpu,
+                          platform=platform)
+    trainer = Trainer(
+        max_epochs=num_epochs,
+        callbacks=list(callbacks or []),
+        plugins=[plugin],
+        limit_train_batches=limit_train_batches,
+        limit_val_batches=limit_val_batches,
+        num_sanity_val_steps=0,
+        enable_checkpointing=False,
+    )
+    trainer.fit(model)
+    return trainer
+
+
+def tune_mnist(data_dir: str = "",
+               num_samples: int = 10,
+               num_epochs: int = 10,
+               num_workers: int = 1,
+               use_tpu: bool = False,
+               platform: str | None = None,
+               limit_train_batches: int | None = None,
+               limit_val_batches: int | None = None):
+    """Random-search sweep over lr/width/batch (tune_mnist analog,
+    examples/ray_ddp_example.py:81-115)."""
+    config = {
+        "layer_1": tune.choice([32, 64, 128]),
+        "layer_2": tune.choice([64, 128, 256]),
+        "lr": tune.loguniform(1e-4, 1e-1),
+        "batch_size": tune.choice([32, 64, 128]),
+    }
+
+    def trainable(cfg):
+        train_mnist(
+            cfg, data_dir, num_epochs=num_epochs, num_workers=num_workers,
+            use_tpu=use_tpu, platform=platform,
+            limit_train_batches=limit_train_batches,
+            limit_val_batches=limit_val_batches,
+            callbacks=[TuneReportCallback(
+                {"loss": "ptl/val_loss", "mean_accuracy": "ptl/val_accuracy"},
+                on="validation_end")],
+        )
+
+    analysis = tune.run(
+        trainable,
+        config=config,
+        num_samples=num_samples,
+        metric="loss",
+        mode="min",
+        resources_per_trial=get_tune_resources(
+            num_workers=num_workers, use_tpu=use_tpu),
+        name="tune_mnist",
+    )
+    print("Best hyperparameters found were:", analysis.best_config)
+    return analysis
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=1,
+                        help="Number of training workers (TPU hosts).")
+    parser.add_argument("--use-tpu", action="store_true", default=False,
+                        help="Reserve TPU chips for each worker.")
+    parser.add_argument("--tune", action="store_true", default=False,
+                        help="Run a Tune hyperparameter sweep.")
+    parser.add_argument("--num-samples", type=int, default=10,
+                        help="Number of Tune trials.")
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--smoke-test", action="store_true", default=False,
+                        help="Tiny run on CPU workers for CI.")
+    parser.add_argument("--address", type=str, default=None,
+                        help="Ray cluster address (e.g. auto / ray://...).")
+    args = parser.parse_args()
+
+    if args.address:
+        import ray
+        ray.init(address=args.address)
+
+    kwargs: dict = dict(num_workers=args.num_workers, use_tpu=args.use_tpu)
+    if args.smoke_test:
+        kwargs.update(platform="cpu", use_tpu=False,
+                      limit_train_batches=4, limit_val_batches=2)
+        args.num_epochs = 1
+        args.num_samples = 2
+
+    if args.tune:
+        tune_mnist(num_samples=args.num_samples,
+                   num_epochs=args.num_epochs, **kwargs)
+    else:
+        trainer = train_mnist({}, num_epochs=args.num_epochs, **kwargs)
+        print("Final metrics:", dict(trainer.callback_metrics))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Train a GPT language model with the ZeRO-1 sharded plugin, measuring
+per-epoch wall time and peak device memory.
+
+Reference: examples/ray_ddp_sharded_example.py — ImageGPT (pl_bolts) under
+``RayShardedPlugin`` with fp16 and ``CUDACallback`` (:16-45), the repo's
+only perf-measurement code.  Here the model is the in-tree GPT family
+(models/gpt.py), sharding is XLA ZeRO-1 (reduce-scatter grads, sharded
+optimizer step, all-gather params) instead of FairScale OSS/SDP, and
+``TPUPerfCallback`` reads PJRT ``memory_stats`` where the reference read
+``torch.cuda.max_memory_allocated``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from ray_lightning_tpu import Callback, RayXlaShardedPlugin, Trainer
+from ray_lightning_tpu.models.gpt import CONFIGS, GPTLightningModule
+
+
+class TPUPerfCallback(Callback):
+    """Epoch wall time + peak device memory (CUDACallback analog,
+    examples/ray_ddp_sharded_example.py:16-45).  Values log through the
+    trainer's metrics, so with distributed plugins they ride the normal
+    rank-0 relay instead of a manual all_reduce."""
+
+    def on_train_epoch_start(self, trainer, module):
+        self._t0 = time.monotonic()
+
+    def on_train_epoch_end(self, trainer, module):
+        elapsed = time.monotonic() - self._t0
+        peak_mb = self._peak_memory_mb()
+        trainer.log_metric("epoch_time_s", round(elapsed, 3))
+        if peak_mb is not None:
+            trainer.log_metric("peak_memory_mb", round(peak_mb, 1))
+        if trainer.is_global_zero:
+            mem = f", peak memory {peak_mb:.0f}MB" if peak_mb else ""
+            print(f"Epoch {trainer.current_epoch}: "
+                  f"{elapsed:.2f}s{mem}", flush=True)
+
+    @staticmethod
+    def _peak_memory_mb():
+        import jax
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:
+            return None
+        if not stats:
+            return None
+        peak = stats.get("peak_bytes_in_use")
+        return peak / 1e6 if peak else None
+
+
+def train(num_workers: int = 1,
+          use_tpu: bool = False,
+          platform: str | None = None,
+          model_size: str = "gpt2-small",
+          num_epochs: int = 1,
+          batch_size: int = 8,
+          dataset_size: int = 256,
+          precision: str = "bf16",
+          limit_train_batches: int | None = None) -> Trainer:
+    cfg = CONFIGS[model_size]
+    module = GPTLightningModule(cfg, dataset_size=dataset_size,
+                                batch_size=batch_size)
+    plugin = RayXlaShardedPlugin(num_workers=num_workers, use_tpu=use_tpu,
+                                 platform=platform)
+    trainer = Trainer(
+        max_epochs=num_epochs,
+        plugins=[plugin],
+        callbacks=[TPUPerfCallback()],
+        precision=precision,
+        limit_train_batches=limit_train_batches,
+        limit_val_batches=0,
+        num_sanity_val_steps=0,
+        enable_checkpointing=False,
+    )
+    trainer.fit(module)
+    return trainer
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=1)
+    parser.add_argument("--use-tpu", action="store_true", default=False)
+    parser.add_argument("--model-size", type=str, default="gpt2-small",
+                        choices=sorted(CONFIGS))
+    parser.add_argument("--num-epochs", type=int, default=1)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--smoke-test", action="store_true", default=False)
+    parser.add_argument("--address", type=str, default=None)
+    args = parser.parse_args()
+
+    if args.address:
+        import ray
+        ray.init(address=args.address)
+
+    kwargs: dict = dict(num_workers=args.num_workers, use_tpu=args.use_tpu,
+                        model_size=args.model_size,
+                        num_epochs=args.num_epochs,
+                        batch_size=args.batch_size)
+    if args.smoke_test:
+        kwargs.update(platform="cpu", use_tpu=False, model_size="tiny",
+                      num_epochs=1, batch_size=2, dataset_size=8,
+                      limit_train_batches=2, precision="32")
+
+    trainer = train(**kwargs)
+    print("Final metrics:", dict(trainer.callback_metrics))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,156 @@
+"""Tune sweep with a LightningDataModule and a worker init hook.
+
+Reference: examples/ray_ddp_tune.py — Tune + pl_bolts MNISTDataModule +
+``init_hook`` FileLock data download (:22-25).  The hermetic analog:
+a DataModule that materializes its synthetic dataset in ``prepare_data``
+via an atomic per-node cache write, and an ``init_hook`` that pre-warms
+the same cache on every worker before training starts (RayXlaPlugin ships the
+hook to each actor first; ray_ddp.py:185-186 parity).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+from ray_lightning_tpu import (
+    DataLoader,
+    LightningDataModule,
+    RayXlaPlugin,
+    Trainer,
+)
+from ray_lightning_tpu import tune
+from ray_lightning_tpu.core.data import ArrayDataset
+from ray_lightning_tpu.models import LightningMNISTClassifier
+from ray_lightning_tpu.models.boring import synthetic_mnist
+from ray_lightning_tpu.tune import TuneReportCallback, get_tune_resources
+
+CACHE = os.path.join(tempfile.gettempdir(), "rlt_mnist_cache.npz")
+
+
+def download_data() -> None:
+    """Materialize the dataset once per node (the reference guards its
+    download with a FileLock, examples/ray_ddp_tune.py:22-25; here an
+    atomic rename makes concurrent regeneration merely redundant)."""
+    if os.path.exists(CACHE):
+        return
+    train = synthetic_mnist(512, seed=0)
+    val = synthetic_mnist(128, seed=1)
+    train_x, train_y = train.take(np.arange(len(train)))
+    val_x, val_y = val.take(np.arange(len(val)))
+    tmp = CACHE.replace(".npz", f".tmp.{os.getpid()}.npz")
+    np.savez(tmp, train_x=train_x, train_y=train_y, val_x=val_x, val_y=val_y)
+    os.replace(tmp, CACHE)  # atomic: concurrent workers race safely
+
+
+class MNISTDataModule(LightningDataModule):
+    def __init__(self, batch_size: int = 32):
+        super().__init__()
+        self.batch_size = batch_size
+        self._train = self._val = None
+
+    def prepare_data(self):
+        download_data()
+
+    def setup(self, stage):
+        data = np.load(CACHE)
+        self._train = ArrayDataset(data["train_x"], data["train_y"])
+        self._val = ArrayDataset(data["val_x"], data["val_y"])
+
+    def train_dataloader(self):
+        return DataLoader(self._train, batch_size=self.batch_size,
+                          shuffle=True)
+
+    def val_dataloader(self):
+        return DataLoader(self._val, batch_size=self.batch_size)
+
+
+def train_mnist(config: dict,
+                num_epochs: int = 10,
+                num_workers: int = 1,
+                use_tpu: bool = False,
+                platform: str | None = None,
+                limit_train_batches: int | None = None,
+                limit_val_batches: int | None = None) -> None:
+    model = LightningMNISTClassifier(config)
+    dm = MNISTDataModule(batch_size=int(config.get("batch_size", 32)))
+    plugin = RayXlaPlugin(num_workers=num_workers, use_tpu=use_tpu,
+                          platform=platform, init_hook=download_data)
+    trainer = Trainer(
+        max_epochs=num_epochs,
+        plugins=[plugin],
+        callbacks=[TuneReportCallback(
+            {"loss": "ptl/val_loss", "mean_accuracy": "ptl/val_accuracy"},
+            on="validation_end")],
+        limit_train_batches=limit_train_batches,
+        limit_val_batches=limit_val_batches,
+        num_sanity_val_steps=0,
+        enable_checkpointing=False,
+    )
+    trainer.fit(model, dm)
+
+
+def tune_mnist(num_samples: int = 10,
+               num_epochs: int = 10,
+               num_workers: int = 1,
+               use_tpu: bool = False,
+               platform: str | None = None,
+               limit_train_batches: int | None = None,
+               limit_val_batches: int | None = None):
+    config = {
+        "layer_1": tune.choice([32, 64, 128]),
+        "layer_2": tune.choice([64, 128, 256]),
+        "lr": tune.loguniform(1e-4, 1e-1),
+        "batch_size": tune.choice([32, 64, 128]),
+    }
+
+    def trainable(cfg):
+        train_mnist(cfg, num_epochs=num_epochs, num_workers=num_workers,
+                    use_tpu=use_tpu, platform=platform,
+                    limit_train_batches=limit_train_batches,
+                    limit_val_batches=limit_val_batches)
+
+    analysis = tune.run(
+        trainable,
+        config=config,
+        num_samples=num_samples,
+        metric="loss",
+        mode="min",
+        resources_per_trial=get_tune_resources(
+            num_workers=num_workers, use_tpu=use_tpu),
+        name="tune_mnist_datamodule",
+    )
+    print("Best hyperparameters found were:", analysis.best_config)
+    return analysis
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=1)
+    parser.add_argument("--use-tpu", action="store_true", default=False)
+    parser.add_argument("--num-samples", type=int, default=10)
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--smoke-test", action="store_true", default=False)
+    parser.add_argument("--address", type=str, default=None)
+    args = parser.parse_args()
+
+    if args.address:
+        import ray
+        ray.init(address=args.address)
+
+    kwargs: dict = dict(num_workers=args.num_workers, use_tpu=args.use_tpu)
+    if args.smoke_test:
+        kwargs.update(platform="cpu", use_tpu=False,
+                      limit_train_batches=4, limit_val_batches=2)
+        args.num_epochs = 1
+        args.num_samples = 1
+
+    tune_mnist(num_samples=args.num_samples, num_epochs=args.num_epochs,
+               **kwargs)
+
+
+if __name__ == "__main__":
+    main()

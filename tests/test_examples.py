@@ -1,0 +1,43 @@
+"""Run every example script in --smoke-test mode, as the reference runs
+its examples end-to-end in CI and under Ray Client (test_client*.py,
+test.yaml:95-103).  Each runs in a subprocess so CLI parsing, imports and
+env handling are exercised exactly as a user would hit them."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = [
+    "ray_lightning_tpu.examples.ray_ddp_example",
+    "ray_lightning_tpu.examples.ray_ddp_tune",
+    "ray_lightning_tpu.examples.ray_ddp_sharded_example",
+    "ray_lightning_tpu.examples.ray_spmd_example",
+]
+
+
+def run_example(module: str, *extra: str) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    # examples choose their own platform; clear the test-session forcing
+    for k in ("XLA_FLAGS",):
+        env.pop(k, None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", module, "--smoke-test", *extra],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.parametrize("module", EXAMPLES)
+def test_example_smoke(module):
+    proc = run_example(module)
+    assert proc.returncode == 0, (
+        f"{module} failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+
+
+def test_ddp_example_tune_smoke():
+    proc = run_example(EXAMPLES[0], "--tune")
+    assert proc.returncode == 0, (
+        f"tune sweep failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+    assert "Best hyperparameters found were" in proc.stdout
