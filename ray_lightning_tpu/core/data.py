@@ -99,6 +99,7 @@ class DataLoader:
         collate_fn: Callable | None = None,
         num_shards: int = 1,
         shard_index: int = 0,
+        prefetch: bool = True,
     ):
         self.dataset = dataset
         self.batch_size = int(batch_size)
@@ -108,6 +109,7 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate
         self.num_shards = num_shards
         self.shard_index = shard_index
+        self.prefetch = prefetch
         self._epoch = 0
         if not hasattr(dataset, "__len__"):
             if shuffle or num_shards > 1:
@@ -128,6 +130,7 @@ class DataLoader:
             collate_fn=self.collate_fn,
             num_shards=num_shards,
             shard_index=shard_index,
+            prefetch=self.prefetch,
         )
         clone._epoch = self._epoch
         return clone
@@ -167,9 +170,14 @@ class DataLoader:
             yield from self.dataset
             return
         idx = self._indices()
+        fast = isinstance(self.dataset, ArrayDataset)
+        if fast and self.prefetch:
+            native_iter = self._native_iter(idx)
+            if native_iter is not None:
+                yield from native_iter
+                return
         n_full = len(idx) // self.batch_size
         end = n_full * self.batch_size if self.drop_last else len(idx)
-        fast = isinstance(self.dataset, ArrayDataset)
         for start in range(0, end, self.batch_size):
             batch_idx = idx[start:start + self.batch_size]
             if len(batch_idx) == 0:
@@ -179,3 +187,39 @@ class DataLoader:
             else:
                 yield self.collate_fn([self.dataset[int(i)]
                                        for i in batch_idx])
+
+    def _native_iter(self, idx: np.ndarray) -> Iterator[Any] | None:
+        """Batch iteration through the C++ prefetch runtime
+        (ray_lightning_tpu.native): background batch assembly with a
+        threaded row-gather, overlapping host work with device compute.
+
+        Identical semantics to the Python path: same order (the index
+        order is computed here and handed over) and caller-owned batch
+        arrays (the prefetcher transfers buffer ownership per batch, so
+        retained batches are never overwritten).  Returns None (→ Python
+        fallback) when the native library or dataset layout is
+        unsupported.
+        """
+        from ray_lightning_tpu import native
+        if not native.native_available():
+            return None
+        ds = self.dataset
+        leaves = ds._leaves
+        # contiguity gate: ascontiguousarray inside the prefetcher would
+        # silently deep-copy the dataset every epoch otherwise
+        if not all(isinstance(a, np.ndarray) and a.dtype != object
+                   and a.flags.c_contiguous for a in leaves):
+            return None
+        if self.drop_last:
+            idx = idx[:(len(idx) // self.batch_size) * self.batch_size]
+        if len(idx) == 0:
+            return None
+
+        def gen():
+            pf = native.NativePrefetcher(leaves, self.batch_size)
+            try:
+                for bufs in pf.iter_epoch(idx):
+                    yield ds._rebuild(bufs)
+            finally:
+                pf.close()
+        return gen()
