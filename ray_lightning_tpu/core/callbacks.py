@@ -11,7 +11,7 @@ run host-side between compiled steps — they never appear inside traces.
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+from typing import Optional
 
 import numpy as np
 

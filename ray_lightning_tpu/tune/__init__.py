@@ -12,7 +12,7 @@ from ray_lightning_tpu.tune.integration import (
     TrialResources,
     TuneReportCallback,
     TuneReportCheckpointCallback,
-    _TuneCheckpointCallback,
+    _TuneCheckpointCallback,  # noqa: F401  (tested internal)
     get_tune_resources,
 )
 from ray_lightning_tpu.tune.runner import (

@@ -23,7 +23,7 @@ import traceback
 from typing import Any, Callable, Optional
 
 from ray_lightning_tpu.tune.schedulers import (
-    CONTINUE, EXPLOIT, STOP, Decision, FIFOScheduler,
+    EXPLOIT, STOP, FIFOScheduler,
     PopulationBasedTraining, TrialScheduler)
 from ray_lightning_tpu.tune.search import generate_variants
 from ray_lightning_tpu.tune.session import TrialSession, set_session

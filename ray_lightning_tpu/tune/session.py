@@ -15,7 +15,7 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
-from typing import Any, Optional
+from typing import Optional
 
 _local = threading.local()
 

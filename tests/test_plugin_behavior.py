@@ -1,0 +1,179 @@
+"""Behavioral distributed-plugin coverage mirroring the rest of the
+reference's test pyramid: driver-without-accelerator isolation
+(DelayedGPUAccelerator parity, util.py:11-37 / ray_ddp.py:188-204),
+per-stage distributed-sampler wiring asserted from inside workers
+(test_ddp.py:177-209), EarlyStopping under actors (test_ddp.py:287-306),
+and finetuning from a distributed checkpoint with a plain local trainer
+(test_ddp_sharded.py:67-105)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+from ray_lightning_tpu import (
+    Callback,
+    EarlyStopping,
+    RayXlaPlugin,
+    Trainer,
+)
+from ray_lightning_tpu.models import BoringModel
+
+from tests.utils import initial_params
+
+
+def cpu_plugin(num_workers=2, **kw):
+    return RayXlaPlugin(num_workers=num_workers, platform="cpu", **kw)
+
+
+def test_driver_needs_no_accelerator(tmp_path):
+    """The driver must never initialize a JAX backend during a
+    distributed fit — the DelayedTPUAccelerator property (reference:
+    CPU-only driver + DelayedGPUAccelerator, util.py:11-37).  Enforced by
+    giving the driver process a platform that cannot initialize: any
+    driver-side backend touch would raise."""
+    script = textwrap.dedent("""
+        from ray_lightning_tpu import Trainer
+        from ray_lightning_tpu.plugins import RayXlaPlugin
+        from ray_lightning_tpu.models import BoringModel
+
+        plugin = RayXlaPlugin(num_workers=2, platform="cpu")
+        trainer = Trainer(plugins=[plugin], max_epochs=1,
+                          limit_train_batches=2, limit_val_batches=1,
+                          num_sanity_val_steps=0,
+                          enable_checkpointing=False, seed=0)
+        model = BoringModel()
+        trainer.fit(model)
+        assert model._trained_variables is not None
+        print("DRIVER_OK")
+    """)
+    env = dict(os.environ)
+    # a platform name that cannot init: any driver-side jax.devices()/
+    # jit would fail loudly.  Workers override via the plugin's env
+    # plumbing (JAX_PLATFORMS=cpu).
+    env["JAX_PLATFORMS"] = "no_such_platform"
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=300,
+                          env=env, cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stderr
+    assert "DRIVER_OK" in proc.stdout
+
+
+def test_distributed_sampler_shards_are_disjoint(tmp_path, seed):
+    """Each worker must see a distinct shard of the training data
+    (DistributedSampler wiring parity, test_ddp.py:177-209).  The
+    recorder is defined in-function so cloudpickle ships it by value —
+    the assertion-via-callback idiom (test_ddp.py:184-204)."""
+
+    class ShardRecorder(Callback):
+        def __init__(self, out_dir: str):
+            self.out_dir = out_dir
+            self.seen: list = []
+
+        def on_train_batch_end(self, trainer, module, outputs, batch,
+                               batch_idx):
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            # BoringModel rows are distinguishable by their first column
+            self.seen.extend(np.asarray(x)[:, 0].tolist())
+
+        def on_train_end(self, trainer, module):
+            path = os.path.join(self.out_dir,
+                                f"shard_rank{trainer.global_rank}.json")
+            with open(path, "w") as f:
+                json.dump({"world_size": trainer.world_size,
+                           "rank": trainer.global_rank,
+                           "seen": self.seen}, f)
+
+    trainer = Trainer(
+        plugins=[cpu_plugin(2)], max_epochs=1,
+        limit_val_batches=0, num_sanity_val_steps=0,
+        enable_checkpointing=False, seed=0,
+        callbacks=[ShardRecorder(str(tmp_path))])
+    trainer.fit(BoringModel(dataset_length=16, batch_size=4))
+
+    shards = []
+    for rank in range(2):
+        with open(tmp_path / f"shard_rank{rank}.json") as f:
+            rec = json.load(f)
+        assert rec["world_size"] == 2
+        shards.append(set(rec["seen"]))
+    assert shards[0] and shards[1]
+    assert shards[0].isdisjoint(shards[1])
+
+
+def test_early_stopping_under_actors(tmp_path, seed):
+    """EarlyStopping inside workers stops the fit before max_epochs, and
+    the epoch count round-trips to the driver (test_ddp.py:287-306)."""
+
+    class PlateauModel(BoringModel):
+        # lr=0 freezes weights → flat val loss → patience trips
+        def __init__(self):
+            super().__init__(lr=0.0)
+
+    trainer = Trainer(
+        plugins=[cpu_plugin(2)], max_epochs=10,
+        limit_train_batches=2, limit_val_batches=1,
+        num_sanity_val_steps=0, enable_checkpointing=False, seed=0,
+        callbacks=[EarlyStopping(monitor="val_loss", patience=1,
+                                 min_delta=1e-9)])
+    trainer.fit(PlateauModel())
+    # flat metric: first epoch sets best, epoch 2 trips patience=1
+    assert trainer.current_epoch < 10
+
+
+def test_finetune_from_distributed_checkpoint(tmp_path, seed):
+    """A checkpoint written by a distributed fit must load into a plain
+    local Trainer for finetuning/resume (test_ddp_sharded.py:67-105)."""
+    root = tmp_path / "dist"
+    trainer = Trainer(
+        plugins=[cpu_plugin(2)], max_epochs=1,
+        limit_train_batches=4, limit_val_batches=1,
+        num_sanity_val_steps=0, seed=0,
+        default_root_dir=str(root))
+    model = BoringModel()
+    trainer.fit(model)
+    best = trainer.checkpoint_callback.best_model_path
+    assert best and os.path.exists(best)
+
+    # finetune locally from the distributed checkpoint
+    local = Trainer(max_epochs=2, limit_train_batches=4,
+                    limit_val_batches=1, num_sanity_val_steps=0,
+                    enable_checkpointing=False, seed=0,
+                    resume_from_checkpoint=best,
+                    default_root_dir=str(tmp_path / "local"))
+    model2 = BoringModel()
+    local.fit(model2)
+    assert local.current_epoch == 2
+    assert model2._trained_variables is not None
+
+    # and evaluation-without-fit consumes the same checkpoint
+    evaluator = Trainer(limit_test_batches=2, enable_checkpointing=False,
+                        num_sanity_val_steps=0, seed=0,
+                        default_root_dir=str(tmp_path / "eval"))
+    results = evaluator.test(BoringModel(), ckpt_path=best)
+    assert results
+
+
+def test_weights_round_trip_differs_from_init(tmp_path, seed):
+    """Driver-side weights after a distributed fit differ from the
+    freshly initialized ones (train_test norm-delta assertion,
+    tests/utils.py:174-183, applied across the actor boundary)."""
+    model = BoringModel()
+    before = initial_params(model)
+    trainer = Trainer(plugins=[cpu_plugin(2)], max_epochs=1,
+                      limit_train_batches=8, limit_val_batches=0,
+                      num_sanity_val_steps=0, enable_checkpointing=False,
+                      seed=0, default_root_dir=str(tmp_path))
+    trainer.fit(model)
+    import jax
+    delta = 0.0
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(model._trained_variables)):
+        delta += float(np.linalg.norm(np.asarray(a) - np.asarray(b)))
+    assert delta > 0.01

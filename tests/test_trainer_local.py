@@ -10,7 +10,6 @@ import pytest
 from ray_lightning_tpu import (
     EarlyStopping,
     ModelCheckpoint,
-    Trainer,
 )
 from ray_lightning_tpu.models import BoringModel, LightningMNISTClassifier
 

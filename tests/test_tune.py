@@ -9,7 +9,6 @@ ASHA early stopping, PBT exploit.
 
 import os
 
-import numpy as np
 import pytest
 
 from ray_lightning_tpu import Trainer
