@@ -22,11 +22,7 @@ from ray_lightning_tpu import (
 )
 from ray_lightning_tpu.models import BoringModel
 
-from tests.utils import initial_params
-
-
-def cpu_plugin(num_workers=2, **kw):
-    return RayXlaPlugin(num_workers=num_workers, platform="cpu", **kw)
+from tests.utils import cpu_plugin, train_test
 
 
 def test_driver_needs_no_accelerator(tmp_path):
@@ -163,17 +159,9 @@ def test_finetune_from_distributed_checkpoint(tmp_path, seed):
 def test_weights_round_trip_differs_from_init(tmp_path, seed):
     """Driver-side weights after a distributed fit differ from the
     freshly initialized ones (train_test norm-delta assertion,
-    tests/utils.py:174-183, applied across the actor boundary)."""
-    model = BoringModel()
-    before = initial_params(model)
+    tests/utils.py, applied across the actor boundary)."""
     trainer = Trainer(plugins=[cpu_plugin(2)], max_epochs=1,
                       limit_train_batches=8, limit_val_batches=0,
                       num_sanity_val_steps=0, enable_checkpointing=False,
                       seed=0, default_root_dir=str(tmp_path))
-    trainer.fit(model)
-    import jax
-    delta = 0.0
-    for a, b in zip(jax.tree_util.tree_leaves(before),
-                    jax.tree_util.tree_leaves(model._trained_variables)):
-        delta += float(np.linalg.norm(np.asarray(a) - np.asarray(b)))
-    assert delta > 0.01
+    train_test(trainer, BoringModel())

@@ -16,11 +16,8 @@ from ray_lightning_tpu import (
 )
 from ray_lightning_tpu.models import BoringModel, LightningMNISTClassifier
 
-from tests.utils import get_trainer, load_test, predict_test, train_test
-
-
-def cpu_plugin(num_workers=2, **kw):
-    return RayXlaPlugin(num_workers=num_workers, platform="cpu", **kw)
+from tests.utils import (
+    cpu_plugin, get_trainer, load_test, predict_test, train_test)
 
 
 # -- constructor / resource parsing (test_ddp.py:136-174 parity) ----------

@@ -15,7 +15,14 @@ import os
 import jax
 import numpy as np
 
-from ray_lightning_tpu import Trainer
+from ray_lightning_tpu import RayXlaPlugin, Trainer
+
+
+def cpu_plugin(num_workers=2, **kw):
+    """Distributed plugin over CPU subprocess workers — the test-time
+    stand-in for TPU hosts (as gloo stood in for NCCL in the reference,
+    ray_ddp.py:149-151)."""
+    return RayXlaPlugin(num_workers=num_workers, platform="cpu", **kw)
 
 
 def get_trainer(root_dir, plugins=None, max_epochs: int = 1,
