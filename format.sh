@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Lint/format gate (reference: format.sh — yapf+flake8, diff-vs-merge-base
+# or --all).  This build standardizes on flake8 only; CI runs the same
+# invocation (.github/workflows/test.yaml lint job).
+#
+# Usage:
+#   ./format.sh          # lint files changed vs the merge-base with main
+#   ./format.sh --all    # lint the whole tree
+
+set -euo pipefail
+cd "$(dirname "$0")"
+
+FLAKE8_ARGS=(--max-line-length=88 --extend-ignore=E203,W503)
+
+if [[ "${1:-}" == "--all" ]]; then
+    exec flake8 "${FLAKE8_ARGS[@]}" ray_lightning_tpu tests
+fi
+
+MERGEBASE="$(git merge-base origin/main HEAD 2>/dev/null \
+             || git merge-base main HEAD 2>/dev/null \
+             || git rev-parse HEAD~1)"
+FILES="$(git diff --name-only --diff-filter=ACRM "$MERGEBASE" -- '*.py')"
+if [[ -z "$FILES" ]]; then
+    echo "No changed python files."
+    exit 0
+fi
+# shellcheck disable=SC2086
+exec flake8 "${FLAKE8_ARGS[@]}" $FILES

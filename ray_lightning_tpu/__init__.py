@@ -38,6 +38,10 @@ from ray_lightning_tpu.core.callbacks import (
     ModelCheckpoint,
 )
 from ray_lightning_tpu.utils.seed import seed_everything
+from ray_lightning_tpu.utils.profiling import (
+    JaxProfilerCallback,
+    ThroughputMonitor,
+)
 from ray_lightning_tpu.plugins import (
     RayXlaPlugin,
     RayXlaShardedPlugin,
@@ -56,6 +60,8 @@ __all__ = [
     "EarlyStopping",
     "ModelCheckpoint",
     "seed_everything",
+    "ThroughputMonitor",
+    "JaxProfilerCallback",
     "RayXlaPlugin",
     "RayXlaShardedPlugin",
     "RayXlaSpmdPlugin",

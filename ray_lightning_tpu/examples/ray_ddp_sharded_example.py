@@ -15,41 +15,27 @@ from __future__ import annotations
 import argparse
 import time
 
-from ray_lightning_tpu import Callback, RayXlaShardedPlugin, Trainer
+from ray_lightning_tpu import RayXlaShardedPlugin, Trainer
 from ray_lightning_tpu.models.gpt import CONFIGS, GPTLightningModule
+from ray_lightning_tpu.utils.profiling import (
+    ThroughputMonitor, peak_device_memory_bytes)
 
 
-class TPUPerfCallback(Callback):
+class TPUPerfCallback(ThroughputMonitor):
     """Epoch wall time + peak device memory (CUDACallback analog,
-    examples/ray_ddp_sharded_example.py:16-45).  Values log through the
-    trainer's metrics, so with distributed plugins they ride the normal
-    rank-0 relay instead of a manual all_reduce."""
-
-    def on_train_epoch_start(self, trainer, module):
-        self._t0 = time.monotonic()
+    examples/ray_ddp_sharded_example.py:16-45).  The measurement itself
+    is the package's ThroughputMonitor — values log through the trainer's
+    metrics and ride the normal rank-0 relay instead of a manual
+    all_reduce; this subclass just adds the example's console line."""
 
     def on_train_epoch_end(self, trainer, module):
-        elapsed = time.monotonic() - self._t0
-        peak_mb = self._peak_memory_mb()
-        trainer.log_metric("epoch_time_s", round(elapsed, 3))
-        if peak_mb is not None:
-            trainer.log_metric("peak_memory_mb", round(peak_mb, 1))
-        if trainer.is_global_zero:
-            mem = f", peak memory {peak_mb:.0f}MB" if peak_mb else ""
+        t0 = self._epoch_t0
+        super().on_train_epoch_end(trainer, module)
+        if trainer.is_global_zero and t0 is not None:
+            peak = peak_device_memory_bytes()
+            mem = f", peak memory {peak / 1e6:.0f}MB" if peak else ""
             print(f"Epoch {trainer.current_epoch}: "
-                  f"{elapsed:.2f}s{mem}", flush=True)
-
-    @staticmethod
-    def _peak_memory_mb():
-        import jax
-        try:
-            stats = jax.local_devices()[0].memory_stats()
-        except Exception:
-            return None
-        if not stats:
-            return None
-        peak = stats.get("peak_bytes_in_use")
-        return peak / 1e6 if peak else None
+                  f"{time.monotonic() - t0:.2f}s{mem}", flush=True)
 
 
 def train(num_workers: int = 1,

@@ -1,0 +1,11 @@
+"""Packaging shim (reference: setup.py:1-12); metadata in pyproject.toml.
+
+The native input-pipeline library (ray_lightning_tpu/native/src) is
+intentionally NOT compiled at install time: it builds lazily on first use
+with the system toolchain and degrades to the pure-Python path when no
+compiler is available (native/__init__.py), so the wheel stays pure.
+"""
+
+from setuptools import setup
+
+setup()
