@@ -87,6 +87,11 @@ class ClusterBackend:
     def get(self, ref: Any) -> Any:
         raise NotImplementedError
 
+    def free(self, ref: Any) -> None:
+        """Release a stored object when the fan-out is done.  Default
+        no-op: reference-counted stores (Ray) reclaim on their own;
+        explicit stores (LocalBackend shm segments) override."""
+
     def queue_get_nowait(self):
         """Pop one worker→driver queue item or None."""
         raise NotImplementedError

@@ -6,8 +6,15 @@ connected to the driver over a unix socket, RPC is length-prefixed
 cloudpickle, and the worker→driver queue rides the same connection as
 unsolicited frames.  This supplies, in-repo, the runtime roles the
 reference outsources to Ray's C++ core (actor RPC, object transport,
-queue — SURVEY.md §2.2); an optional C++ shared-memory object store
-accelerates large-payload transport (native/, used when built).
+queue — SURVEY.md §2.2).
+
+Large payloads (the pickled trainer+model, ray.put analog at
+ray_ddp.py:331) go through a shared-memory object store: ``put`` writes
+the serialized object ONCE to a file under /dev/shm and returns a
+:class:`LocalObjectRef`; refs appearing in call arguments are resolved
+worker-side by mapping the segment read-only — N workers share the
+driver's pages instead of receiving N socket copies (the plasma-store
+behavior of Ray, SURVEY.md §2.2 "Ray core" row).
 """
 
 from __future__ import annotations
@@ -31,12 +38,39 @@ from ray_lightning_tpu.cluster.protocol import Connection
 
 
 class LocalObjectRef:
-    """Reference into the driver-side object store."""
+    """Reference to a shared-memory object-store segment.
 
-    __slots__ = ("object_id",)
+    Carries the segment path, so any process on the node can resolve it
+    without a driver round-trip (``load``).  The worker call layer
+    auto-resolves refs found in call args (worker_main.py), mirroring
+    Ray's deref-on-delivery semantics for ObjectRefs.
+    """
 
-    def __init__(self, object_id: str):
+    __slots__ = ("object_id", "path")
+
+    def __init__(self, object_id: str, path: str):
         self.object_id = object_id
+        self.path = path
+
+    def load(self) -> Any:
+        import mmap
+        with open(self.path, "rb") as f:
+            with mmap.mmap(f.fileno(), 0,
+                           access=mmap.ACCESS_READ) as m:
+                # loads() reads straight from the mapped pages — the
+                # only copy is deserialization itself
+                return cloudpickle.loads(m)
+
+
+def resolve_refs(args: tuple, kwargs: Optional[dict] = None):
+    """Top-level deref of object refs in call args/kwargs (Ray derefs
+    top-level ObjectRefs in both)."""
+    out_args = tuple(a.load() if isinstance(a, LocalObjectRef) else a
+                     for a in args)
+    out_kwargs = {
+        k: (v.load() if isinstance(v, LocalObjectRef) else v)
+        for k, v in (kwargs or {}).items()}
+    return out_args, out_kwargs
 
 
 class LocalActorHandle(ActorHandle):
@@ -143,6 +177,8 @@ class RemoteActorError(RuntimeError):
 
 
 class LocalBackend(ClusterBackend):
+    supports_object_store = True  # shm segments, see module docstring
+
     def __init__(self):
         self._dir = tempfile.mkdtemp(prefix="rlt_cluster_")
         self._sock_path = os.path.join(self._dir, "driver.sock")
@@ -152,7 +188,7 @@ class LocalBackend(ClusterBackend):
         self._listener.bind(self._sock_path)
         self._listener.listen(64)
         self._actors: dict[str, LocalActorHandle] = {}
-        self._objects: dict[str, bytes] = {}
+        self._objects: dict[str, str] = {}  # object_id -> segment path
         self._queue: list[Any] = []
         self._queue_lock = threading.Lock()
         self._closed = False
@@ -206,22 +242,52 @@ class LocalBackend(ClusterBackend):
         self._actors[actor_id] = handle
         return handle
 
-    # -- object store -----------------------------------------------------
+    # -- shared-memory object store ---------------------------------------
+
+    @staticmethod
+    def _shm_dir() -> str:
+        d = "/dev/shm"
+        return d if os.path.isdir(d) and os.access(d, os.W_OK) \
+            else tempfile.gettempdir()
 
     def put(self, obj: Any) -> LocalObjectRef:
         oid = uuid.uuid4().hex
-        self._objects[oid] = cloudpickle.dumps(obj)
-        return LocalObjectRef(oid)
+        path = os.path.join(self._shm_dir(), f"rlt-obj-{oid}")
+        blob = cloudpickle.dumps(obj)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        # 0600: /dev/shm is world-listable; the payload is the pickled
+        # trainer+model and must not be readable by other local users
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)  # visible to workers only when complete
+        except BaseException:
+            # never leak a partial multi-GB segment in shm (ENOSPC etc.)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self._objects[oid] = path
+        return LocalObjectRef(oid, path)
 
     def get(self, ref: Any) -> Any:
         if isinstance(ref, LocalObjectRef):
-            return cloudpickle.loads(self._objects[ref.object_id])
+            return ref.load()
         if isinstance(ref, Future):
             return ref.result()
         return ref
 
-    def resolve_ref_payload(self, object_id: str) -> bytes:
-        return self._objects[object_id]
+    def free(self, ref: LocalObjectRef) -> None:
+        """Drop a stored object's segment (plugins free the shipped
+        payload after the workers finish)."""
+        path = self._objects.pop(ref.object_id, None)
+        if path:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
     def available_resources(self) -> dict[str, float]:
         return {"CPU": float(os.cpu_count() or 1)}
@@ -231,6 +297,11 @@ class LocalBackend(ClusterBackend):
         for handle in list(self._actors.values()):
             handle.kill()
         self._actors.clear()
+        for path in self._objects.values():
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         self._objects.clear()
         try:
             self._listener.close()

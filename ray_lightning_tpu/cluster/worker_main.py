@@ -53,8 +53,14 @@ def main() -> int:
             continue
         call_id = msg["call_id"]
         try:
+            from ray_lightning_tpu.cluster.local import resolve_refs
             method = getattr(actor, msg["method"])
-            value = method(*msg.get("args", ()), **msg.get("kwargs", {}))
+            # object refs in args/kwargs resolve here, from shared
+            # memory — the payload bytes never ride the socket (Ray
+            # deref-on-delivery parity)
+            args, kwargs = resolve_refs(msg.get("args", ()),
+                                        msg.get("kwargs", {}))
+            value = method(*args, **kwargs)
             _conn.send({"type": "result", "call_id": call_id, "ok": True,
                         "value": value})
         except BaseException:

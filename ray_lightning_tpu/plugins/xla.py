@@ -259,14 +259,20 @@ class RayXlaPlugin(ExecutionPlugin):
                      else WorkerQueueProxy())
 
         payload = (trainer, module, datamodule, stage, ckpt_path)
+        payload_ref = None
         if backend.supports_object_store:
-            payload = backend.put(payload)  # ship once via object store
+            # ship once via the object store; workers deref on delivery
+            payload = payload_ref = backend.put(payload)
 
-        futures = [
-            w.call("execute", _worker_run, payload, i, queue)
-            for i, w in enumerate(workers)
-        ]
-        results = process_results(futures, backend)
+        try:
+            futures = [
+                w.call("execute", _worker_run, payload, i, queue)
+                for i, w in enumerate(workers)
+            ]
+            results = process_results(futures, backend)
+        finally:
+            if payload_ref is not None:
+                backend.free(payload_ref)
         return self._post_dispatch(trainer, module, stage, results)
 
     @staticmethod

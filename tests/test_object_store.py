@@ -1,0 +1,81 @@
+"""Shared-memory object store: write-once payloads resolved worker-side
+from mapped segments (the ray.put fan-out analog, ray_ddp.py:331 /
+SURVEY.md §2.2 plasma-store row)."""
+
+import os
+
+import numpy as np
+
+from ray_lightning_tpu.cluster.executor import RLTExecutor
+from ray_lightning_tpu.cluster.local import (
+    LocalBackend,
+    LocalObjectRef,
+    resolve_refs,
+)
+
+
+def test_put_get_roundtrip():
+    backend = LocalBackend()
+    try:
+        obj = {"a": np.arange(1000), "b": "text", "c": (1, 2.5)}
+        ref = backend.put(obj)
+        assert isinstance(ref, LocalObjectRef)
+        assert os.path.exists(ref.path)
+        got = backend.get(ref)
+        np.testing.assert_array_equal(got["a"], obj["a"])
+        assert got["b"] == "text" and got["c"] == (1, 2.5)
+    finally:
+        backend.shutdown()
+
+
+def test_resolve_refs_top_level_only():
+    backend = LocalBackend()
+    try:
+        ref = backend.put([1, 2, 3])
+        args, kwargs = resolve_refs(("plain", ref, {"nested": ref}),
+                                    {"kw": ref})
+        assert args[0] == "plain"
+        assert args[1] == [1, 2, 3]
+        # nested refs stay refs (Ray deref-on-delivery parity)
+        assert isinstance(args[2]["nested"], LocalObjectRef)
+        # but top-level kwargs deref, as in Ray
+        assert kwargs["kw"] == [1, 2, 3]
+    finally:
+        backend.shutdown()
+
+
+def test_free_unlinks_segment():
+    backend = LocalBackend()
+    try:
+        ref = backend.put(b"x" * 4096)
+        path = ref.path
+        assert os.path.exists(path)
+        backend.free(ref)
+        assert not os.path.exists(path)
+        backend.free(ref)  # double-free is a no-op
+    finally:
+        backend.shutdown()
+
+
+def test_shutdown_cleans_segments():
+    backend = LocalBackend()
+    ref = backend.put(b"y" * 4096)
+    backend.shutdown()
+    assert not os.path.exists(ref.path)
+
+
+def test_worker_derefs_payload():
+    """An actor method receiving an object ref gets the VALUE — the bytes
+    arrive via the shared segment, not the socket."""
+    backend = LocalBackend()
+    try:
+        payload = {"arr": np.arange(256), "tag": "via-shm"}
+        ref = backend.put(payload)
+        actor = backend.create_actor(RLTExecutor, name="store-test")
+        got = actor.call(
+            "execute", lambda p: (p["tag"], int(p["arr"].sum())),
+            ref).result(timeout=120)
+        assert got == ("via-shm", int(np.arange(256).sum()))
+        actor.kill()
+    finally:
+        backend.shutdown()
