@@ -6,10 +6,12 @@ bandwidth is the TPU bottleneck (BASELINE.md).  Flash attention streams
 K/V blocks through VMEM with an online softmax, so HBM traffic stays
 O(T·D) and the MXU stays busy on [block_q × D] @ [D × block_k] tiles.
 
-Block sizes default to 128 (MXU native tile); both are clamped to the
-sequence length and halved until they divide it, so any power-of-two-ish
-T works.  Causal masking skips fully-masked K blocks at the grid level
-(``@pl.when``) — ~2× fewer FLOPs for causal LMs.
+Block sizes default to 512×512 (measured best on v5e across T=2k-8k:
+3.3× over 128×128 at T=4096, and 2.8× over XLA's materialized-scores
+attention, which stops compiling at all by T=8192); both are clamped to
+the sequence length and halved until they divide it, so any
+power-of-two-ish T works.  Causal masking skips fully-masked K blocks at
+the grid level (``@pl.when``) — ~2× fewer FLOPs for causal LMs.
 
 The backward pass follows the standard two-kernel flash decomposition
 (dK/dV accumulate over Q blocks; dQ accumulates over K blocks) with the
@@ -70,9 +72,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # operands stay in their storage dtype (bf16): the MXU takes
+        # bf16 inputs with fp32 accumulation via preferred_element_type;
+        # upcasting first would quarter matmul throughput.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale      # [bq, bk]
@@ -88,10 +93,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         s_max = jnp.max(s, axis=-1, keepdims=True)               # [bq, 1]
         m_new = jnp.maximum(m_prev, s_max)                       # [bq, 128]
         alpha = jnp.exp(m_prev - m_new)                          # [bq, 128]
-        p = jnp.exp(s - m_new[:, :1])                            # [bq, bk]
+        p = jnp.exp(s - m_new[:, :1])                            # [bq, bk] f32
         l_ref[:] = alpha * l_ref[:] + jnp.sum(p, -1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha[:, :1] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = m_new
 
@@ -158,10 +163,11 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 matmul operands + fp32 accumulation (see _fwd_kernel)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]                                         # [bq, 1]
         delta = delta_ref[0]                                     # [bq, 1]
         s = jax.lax.dot_general(
@@ -175,16 +181,16 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                              (block_q, block_k), 1)
                     + ki * block_k)
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)                                     # [bq, bk]
+        p = jnp.exp(s - lse)                                     # [bq, bk] f32
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                  # [bk, d]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)                  # [bq, bk]
         ds = p * (dp - delta)
         dk_acc[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                  # [bk, d]
 
     @pl.when(qi == nq - 1)
@@ -207,10 +213,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 matmul operands + fp32 accumulation (see _fwd_kernel)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
         s = jax.lax.dot_general(
@@ -230,7 +237,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         dq_acc[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                  # [bq, d]
 
     @pl.when(kb == nk - 1)
@@ -327,8 +334,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = True, dtype=jnp.bfloat16,
-                    sm_scale: float | None = None, block_q: int = 128,
-                    block_k: int = 128, interpret: bool | None = None):
+                    sm_scale: float | None = None, block_q: int = 512,
+                    block_k: int = 512, interpret: bool | None = None):
     """Flash attention over ``[B, T, H, D]`` tensors (BTHD in, BTHD out).
 
     Drop-in for :func:`~ray_lightning_tpu.models.gpt.dot_product_attention`
