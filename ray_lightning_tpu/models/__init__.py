@@ -3,9 +3,31 @@ from ray_lightning_tpu.models.boring import (
     LightningMNISTClassifier,
     RandomDataset,
 )
+from ray_lightning_tpu.models.gpt import GPT, GPTConfig, GPTLightningModule
+from ray_lightning_tpu.models.resnet import (
+    ResNet,
+    ResNetConfig,
+    ResNetLightningModule,
+)
+from ray_lightning_tpu.models.bert import (
+    BertClassifier,
+    BertConfig,
+    BertEncoder,
+    BertLightningModule,
+)
 
 __all__ = [
     "BoringModel",
     "LightningMNISTClassifier",
     "RandomDataset",
+    "GPT",
+    "GPTConfig",
+    "GPTLightningModule",
+    "ResNet",
+    "ResNetConfig",
+    "ResNetLightningModule",
+    "BertClassifier",
+    "BertConfig",
+    "BertEncoder",
+    "BertLightningModule",
 ]

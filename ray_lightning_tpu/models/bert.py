@@ -1,0 +1,213 @@
+"""BERT-style bidirectional encoder for fine-tuning.
+
+BASELINE.md config #4: "BERT-base fine-tune via RayXlaShardedPlugin
+(FairScale OSS → XLA ZeRO-1)".  The reference has no in-tree language
+models at all (only pl_bolts imports); this family supplies the
+fine-tune workload TPU-first:
+
+- bf16 compute / fp32 params (gpt.py pattern), bidirectional attention
+  through the same attention impls as GPT (``dot`` XLA attention or the
+  Pallas flash kernel with ``causal=False``);
+- a classification head for sequence-level fine-tuning plus an MLM head
+  for pretraining-style objectives;
+- synthetic class-dependent token data for hermetic learning tests;
+- Megatron-style partition rules (qkv/mlp-in column, proj/mlp-out row)
+  reusable by SpmdStrategy for tensor-parallel fine-tunes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from ray_lightning_tpu.core.data import ArrayDataset, DataLoader
+from ray_lightning_tpu.core.module import LightningModule
+from ray_lightning_tpu.ops.attention import MultiHeadAttention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30592          # 30522 padded to a multiple of 128
+    max_len: int = 512
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    intermediate: int = 3072
+    num_classes: int = 2
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+
+CONFIGS = {
+    "tiny": BertConfig(vocab_size=512, max_len=64, n_layer=2, n_head=2,
+                       n_embd=64, intermediate=128),
+    "bert-base": BertConfig(),
+    "bert-large": BertConfig(n_layer=24, n_head=16, n_embd=1024,
+                             intermediate=4096),
+}
+
+
+class EncoderLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        B, T, C = x.shape
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
+        x = x + MultiHeadAttention(
+            n_head=cfg.n_head, causal=False,  # bidirectional encoder
+            dropout=cfg.dropout, dtype=cfg.dtype,
+            attention_impl=cfg.attention_impl, name="attn")(
+            h, deterministic)
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
+        h = nn.gelu(nn.Dense(cfg.intermediate, dtype=cfg.dtype,
+                             name="fc")(h))
+        h = nn.Dense(C, dtype=cfg.dtype, name="out")(h)
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return x + h
+
+
+class BertEncoder(nn.Module):
+    """``__call__(tokens[B,T]) -> hidden[B,T,C]`` (pre-LN encoder)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, idx, deterministic: bool = True):
+        cfg = self.config
+        B, T = idx.shape
+        tok = nn.Embed(cfg.vocab_size, cfg.n_embd, name="wte",
+                       dtype=cfg.dtype)(idx)
+        pos = self.param("wpe", nn.initializers.normal(0.02),
+                         (cfg.max_len, cfg.n_embd))
+        x = tok + pos[:T].astype(cfg.dtype)
+        for i in range(cfg.n_layer):
+            x = EncoderLayer(cfg, name=f"h{i}")(x, deterministic)
+        return nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+
+
+class BertClassifier(nn.Module):
+    """Sequence classification: mean-pooled encoder output → classes."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, idx, deterministic: bool = True):
+        cfg = self.config
+        h = BertEncoder(cfg, name="encoder")(idx, deterministic)
+        pooled = jnp.mean(h.astype(jnp.float32), axis=1)
+        pooled = jnp.tanh(nn.Dense(cfg.n_embd, dtype=jnp.float32,
+                                   name="pooler")(pooled))
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                        name="classifier")(pooled)
+
+
+def bert_partition_rules(tensor_axis: str = "tensor") -> list:
+    """SpmdStrategy rules: Megatron column/row splits (gpt.py pattern)."""
+    t = tensor_axis
+    return [
+        ("wte/embedding", P(t, None)),
+        ("qkv/kernel", P(None, t)),
+        ("proj/kernel", P(t, None)),
+        ("fc/kernel", P(None, t)),
+        ("out/kernel", P(t, None)),
+        (".*", P()),
+    ]
+
+
+def synthetic_classification(n: int, cfg: BertConfig,
+                             seed: int = 0) -> ArrayDataset:
+    """Class-dependent token distributions: each class draws tokens from
+    its own vocab band, so a short fine-tune must become separable."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, cfg.num_classes, size=n)
+    band = cfg.vocab_size // max(2, cfg.num_classes)
+    tokens = (rng.integers(0, band, size=(n, cfg.max_len))
+              + labels[:, None] * band)
+    return ArrayDataset(tokens.astype(np.int32), labels.astype(np.int32))
+
+
+class BertLightningModule(LightningModule):
+    """Sequence-classification fine-tune (BASELINE config #4 workload)."""
+
+    def __init__(self, config: "BertConfig | str" = "tiny",
+                 lr: float = 5e-5, weight_decay: float = 0.01,
+                 warmup_steps: int = 10, batch_size: int = 8,
+                 train_size: int = 256, val_size: int = 64):
+        super().__init__()
+        if isinstance(config, str):
+            config = CONFIGS[config]
+        self.config = config
+        self.save_hyperparameters("lr", "batch_size")
+        self.lr = lr
+        self.weight_decay = weight_decay
+        self.warmup_steps = warmup_steps
+        self.batch_size = batch_size
+        self.train_size = train_size
+        self.val_size = val_size
+
+    def configure_model(self):
+        return BertClassifier(self.config)
+
+    def configure_optimizers(self):
+        sched = optax.linear_schedule(0.0, self.lr, self.warmup_steps)
+        return optax.adamw(sched, weight_decay=self.weight_decay)
+
+    def _logits_loss_acc(self, ctx, batch):
+        tokens, labels = batch
+        logits = ctx.apply(tokens, not ctx.training)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels)
+                       .astype(jnp.float32))
+        return logits, loss, acc
+
+    def training_step(self, ctx, batch):
+        _, loss, acc = self._logits_loss_acc(ctx, batch)
+        ctx.log("loss", loss)
+        ctx.log("train_accuracy", acc)
+        return loss
+
+    def validation_step(self, ctx, batch):
+        _, loss, acc = self._logits_loss_acc(ctx, batch)
+        ctx.log("val_loss", loss)
+        ctx.log("val_accuracy", acc)
+
+    def test_step(self, ctx, batch):
+        _, loss, acc = self._logits_loss_acc(ctx, batch)
+        ctx.log("test_loss", loss)
+        ctx.log("test_accuracy", acc)
+
+    def predict_step(self, ctx, batch):
+        tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
+        return jnp.argmax(ctx.apply(tokens, True), -1)
+
+    def _loader(self, n, seed, shuffle=False):
+        return DataLoader(synthetic_classification(n, self.config, seed),
+                          batch_size=self.batch_size, shuffle=shuffle,
+                          drop_last=True)
+
+    def train_dataloader(self):
+        return self._loader(self.train_size, 0, shuffle=True)
+
+    def val_dataloader(self):
+        return self._loader(self.val_size, 1)
+
+    def test_dataloader(self):
+        return self._loader(self.val_size, 2)
+
+    def predict_dataloader(self):
+        return self.test_dataloader()

@@ -22,10 +22,9 @@ flax implementation shaped for the TPU, not a port of any torch model:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable
+from typing import Any
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -33,6 +32,14 @@ from jax.sharding import PartitionSpec as P
 
 from ray_lightning_tpu.core.data import ArrayDataset, DataLoader
 from ray_lightning_tpu.core.module import LightningModule
+from ray_lightning_tpu.ops.attention import (  # noqa: F401  (re-export:
+    MultiHeadAttention,           # tests and user code import the attention
+    dot_product_attention,        # entry points from the model module)
+    resolve_attention,
+)
+
+# back-compat alias (attention dispatch now lives in ops/attention.py)
+_resolve_attention = resolve_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,73 +75,6 @@ CONFIGS = {
 }
 
 
-def dot_product_attention(q, k, v, *, causal: bool = True,
-                          dtype=jnp.bfloat16):
-    """Reference attention: one fused softmax(QKᵀ)V in fp32 accumulation.
-
-    q,k,v: [B, T, H, D].  XLA fuses mask+softmax into the matmuls; for
-    long T prefer the pallas flash kernel (ops/flash_attention.py).
-    """
-    d = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                        preferred_element_type=jnp.float32)
-    scores = scores / np.sqrt(d)
-    if causal:
-        tq, tk = scores.shape[-2], scores.shape[-1]
-        mask = jnp.tril(jnp.ones((tq, tk), dtype=bool), tk - tq)
-        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-
-
-def _auto_attention(q, k, v, **kw):
-    """Pick the attention path at trace time: the Pallas flash kernel on
-    a single-device TPU (measured faster at every seq length on v5e —
-    +40% whole-step on gpt2-small, and the only path that runs at T≥8k
-    where materialized [T,T] scores exhaust HBM), XLA dot attention
-    elsewhere (CPU tests; multi-device meshes, where the kernel would
-    need an explicit shard_map wrapper — parallel/ring.py provides the
-    sequence-parallel composition)."""
-    if jax.devices()[0].platform == "tpu" and jax.device_count() == 1:
-        from ray_lightning_tpu.ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, **kw)
-    return dot_product_attention(q, k, v, **kw)
-
-
-def _resolve_attention(impl: str) -> Callable:
-    if impl == "auto":
-        return _auto_attention
-    if impl == "dot":
-        return dot_product_attention
-    if impl == "flash":
-        from ray_lightning_tpu.ops.flash_attention import flash_attention
-        return flash_attention
-    if impl == "ring":
-        from ray_lightning_tpu.parallel.ring import ring_attention
-        return ring_attention
-    raise ValueError(f"Unknown attention_impl {impl!r}")
-
-
-class CausalSelfAttention(nn.Module):
-    config: GPTConfig
-
-    @nn.compact
-    def __call__(self, x, deterministic: bool = True):
-        cfg = self.config
-        B, T, C = x.shape
-        qkv = nn.Dense(3 * C, dtype=cfg.dtype, name="qkv")(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (B, T, cfg.n_head, cfg.head_dim)
-        q, k, v = (a.reshape(shape) for a in (q, k, v))
-        attend = _resolve_attention(cfg.attention_impl)
-        y = attend(q, k, v, causal=True, dtype=cfg.dtype)
-        y = y.reshape(B, T, C)
-        y = nn.Dense(C, dtype=cfg.dtype, name="proj")(y)
-        if cfg.dropout > 0:
-            y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
-        return y
-
-
 class MLP(nn.Module):
     config: GPTConfig
 
@@ -155,7 +95,10 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
         cfg = self.config
-        x = x + CausalSelfAttention(cfg, name="attn")(
+        x = x + MultiHeadAttention(
+            n_head=cfg.n_head, causal=True, dropout=cfg.dropout,
+            dtype=cfg.dtype, attention_impl=cfg.attention_impl,
+            name="attn")(
             nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x), deterministic)
         x = x + MLP(cfg, name="mlp")(
             nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x), deterministic)

@@ -1,0 +1,216 @@
+"""ResNet family (v1.5 bottleneck) for image classification.
+
+BASELINE.md config #2: "ResNet-50 / CIFAR-10 LightningModule via
+RayXlaPlugin DDP".  The reference trains vision models only through
+pl_bolts imports (examples/ray_ddp_sharded_example.py:8); here the model
+family is in-tree and TPU-first:
+
+- NHWC layout throughout — the native TPU convolution layout (XLA lowers
+  NHWC convs straight onto the MXU without transposes);
+- bf16 compute with fp32 params and fp32 BatchNorm statistics (the
+  running means/vars live in the ``batch_stats`` collection, threaded
+  through the compiled step by StepContext — core/module.py:94-102);
+- synthetic CIFAR-10-shaped data for hermetic learning-signal tests
+  (no downloads in CI, same device as models/boring.py synthetic_mnist).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from ray_lightning_tpu.core.data import ArrayDataset, DataLoader
+from ray_lightning_tpu.core.module import LightningModule
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)   # resnet-50
+    bottleneck: bool = True
+    num_classes: int = 10
+    width: int = 64
+    # cifar stem: 3x3/s1 conv, no max-pool (32x32 inputs); imagenet stem:
+    # 7x7/s2 + 3x3 max-pool
+    cifar_stem: bool = True
+    dtype: Any = jnp.bfloat16
+
+
+CONFIGS = {
+    "resnet18": ResNetConfig(stage_sizes=(2, 2, 2, 2), bottleneck=False),
+    "resnet34": ResNetConfig(stage_sizes=(3, 4, 6, 3), bottleneck=False),
+    "resnet50": ResNetConfig(stage_sizes=(3, 4, 6, 3), bottleneck=True),
+    "resnet101": ResNetConfig(stage_sizes=(3, 4, 23, 3), bottleneck=True),
+}
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3))(y)
+        # zero-init the last norm's scale: residual branches start as
+        # identity, the standard trick for stable large-batch training
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1),
+                            (self.strides, self.strides),
+                            name="proj")(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            (self.strides, self.strides),
+                            name="proj")(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """``__call__(images[N,H,W,C], train) -> logits``; NHWC, bf16."""
+
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.config
+        x = x.astype(cfg.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=cfg.dtype)
+        if cfg.cifar_stem:
+            x = nn.Conv(cfg.width, (3, 3), use_bias=False,
+                        dtype=cfg.dtype, name="stem")(x)
+        else:
+            x = nn.Conv(cfg.width, (7, 7), (2, 2), use_bias=False,
+                        dtype=cfg.dtype, name="stem")(x)
+        x = nn.relu(norm(name="stem_bn")(x))
+        if not cfg.cifar_stem:
+            x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        block = BottleneckBlock if cfg.bottleneck else ResNetBlock
+        for stage, n_blocks in enumerate(cfg.stage_sizes):
+            for b in range(n_blocks):
+                strides = 2 if stage > 0 and b == 0 else 1
+                x = block(cfg.width * 2 ** stage, strides, cfg.dtype,
+                          name=f"s{stage}b{b}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))                    # global avg pool
+        # head in fp32: tiny matmul, and logits feed the loss softmax
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                        name="head")(x.astype(jnp.float32))
+
+
+def synthetic_cifar10(n: int, seed: int = 0) -> ArrayDataset:
+    """Separable CIFAR-10-shaped data: class-dependent mean images plus
+    noise (hermetic learning-signal tests, models/boring.py pattern)."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n)
+    base = np.random.default_rng(1234).standard_normal(
+        (10, 32, 32, 3)).astype(np.float32)
+    x = base[labels] + 0.4 * rng.standard_normal(
+        (n, 32, 32, 3)).astype(np.float32)
+    return ArrayDataset(x.astype(np.float32), labels.astype(np.int32))
+
+
+class ResNetLightningModule(LightningModule):
+    """Image-classification module (BASELINE config #2 workload)."""
+
+    def __init__(self, config: "ResNetConfig | str" = "resnet50",
+                 lr: float = 0.1, momentum: float = 0.9,
+                 weight_decay: float = 1e-4, batch_size: int = 32,
+                 train_size: int = 512, val_size: int = 128):
+        super().__init__()
+        if isinstance(config, str):
+            config = CONFIGS[config]
+        self.config = config
+        self.save_hyperparameters("lr", "momentum", "batch_size")
+        self.lr = lr
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.batch_size = batch_size
+        self.train_size = train_size
+        self.val_size = val_size
+
+    def configure_model(self):
+        return ResNet(self.config)
+
+    def configure_optimizers(self):
+        return optax.chain(
+            optax.add_decayed_weights(self.weight_decay),
+            optax.sgd(self.lr, momentum=self.momentum, nesterov=True))
+
+    def _logits_loss_acc(self, ctx, batch):
+        x, y = batch
+        logits = ctx.apply(x, ctx.training)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return logits, loss, acc
+
+    def training_step(self, ctx, batch):
+        _, loss, acc = self._logits_loss_acc(ctx, batch)
+        ctx.log("loss", loss)
+        ctx.log("train_accuracy", acc)
+        return loss
+
+    def validation_step(self, ctx, batch):
+        _, loss, acc = self._logits_loss_acc(ctx, batch)
+        ctx.log("val_loss", loss)
+        ctx.log("val_accuracy", acc)
+
+    def test_step(self, ctx, batch):
+        _, loss, acc = self._logits_loss_acc(ctx, batch)
+        ctx.log("test_loss", loss)
+        ctx.log("test_accuracy", acc)
+
+    def predict_step(self, ctx, batch):
+        x = batch[0] if isinstance(batch, (tuple, list)) else batch
+        return jnp.argmax(ctx.apply(x, False), -1)
+
+    def _loader(self, n, seed, shuffle=False):
+        return DataLoader(synthetic_cifar10(n, seed),
+                          batch_size=self.batch_size, shuffle=shuffle,
+                          drop_last=True)
+
+    def train_dataloader(self):
+        return self._loader(self.train_size, 0, shuffle=True)
+
+    def val_dataloader(self):
+        return self._loader(self.val_size, 1)
+
+    def test_dataloader(self):
+        return self._loader(self.val_size, 2)
+
+    def predict_dataloader(self):
+        return self.test_dataloader()

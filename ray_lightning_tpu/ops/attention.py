@@ -1,0 +1,101 @@
+"""Attention dispatch + the shared multi-head attention block.
+
+One home for the attention path selection used by every model family
+(GPT decoder, BERT encoder) so kernel improvements land in one place:
+
+- :func:`dot_product_attention` — XLA reference attention (materialized
+  scores, fp32 softmax);
+- the Pallas flash kernel (ops/flash_attention.py) — streaming online
+  softmax, the fast path on TPU;
+- ring attention (parallel/ring.py) — sequence-parallel flash whose KV
+  blocks rotate around the mesh;
+- :func:`auto_attention` — trace-time choice: flash on single-device
+  TPU (measured faster at every seq length on v5e, and the only path at
+  T≥8k), dot elsewhere (CPU tests; multi-device meshes, where the
+  kernel needs the ring/shard_map composition instead).
+
+:class:`MultiHeadAttention` carries the qkv/attend/proj plumbing shared
+by the model families; its submodule names (``qkv``, ``proj``) are part
+of the checkpoint/partition-rule contract (``attn/qkv/kernel`` etc. in
+``gpt_partition_rules`` / ``bert_partition_rules``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+def dot_product_attention(q, k, v, *, causal: bool = True,
+                          dtype=jnp.bfloat16):
+    """Reference attention: one fused softmax(QKᵀ)V in fp32 accumulation.
+
+    q,k,v: [B, T, H, D].  XLA fuses mask+softmax into the matmuls; for
+    long T prefer the pallas flash kernel (ops/flash_attention.py).
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / np.sqrt(d)
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), dtype=bool), tk - tq)
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def auto_attention(q, k, v, **kw):
+    """Trace-time attention choice (see module docstring)."""
+    if jax.devices()[0].platform == "tpu" and jax.device_count() == 1:
+        from ray_lightning_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, **kw)
+    return dot_product_attention(q, k, v, **kw)
+
+
+def resolve_attention(impl: str) -> Callable:
+    if impl == "auto":
+        return auto_attention
+    if impl == "dot":
+        return dot_product_attention
+    if impl == "flash":
+        from ray_lightning_tpu.ops.flash_attention import flash_attention
+        return flash_attention
+    if impl == "ring":
+        from ray_lightning_tpu.parallel.ring import ring_attention
+        return ring_attention
+    raise ValueError(f"Unknown attention_impl {impl!r}")
+
+
+class MultiHeadAttention(nn.Module):
+    """Fused-QKV multi-head attention: ``[B,T,C] -> [B,T,C]``.
+
+    Shared by the GPT decoder (causal=True) and BERT encoder
+    (causal=False).  Submodule names qkv/proj are load-bearing for
+    partition rules and checkpoints.
+    """
+
+    n_head: int
+    causal: bool = True
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        B, T, C = x.shape
+        head_dim = C // self.n_head
+        qkv = nn.Dense(3 * C, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (B, T, self.n_head, head_dim)
+        q, k, v = (a.reshape(shape) for a in (q, k, v))
+        attend = resolve_attention(self.attention_impl)
+        y = attend(q, k, v, causal=self.causal, dtype=self.dtype)
+        y = nn.Dense(C, dtype=self.dtype, name="proj")(y.reshape(B, T, C))
+        if self.dropout > 0:
+            y = nn.Dropout(self.dropout)(y, deterministic=deterministic)
+        return y
