@@ -8,8 +8,7 @@ fine-tune workload TPU-first:
 - bf16 compute / fp32 params (gpt.py pattern), bidirectional attention
   through the same attention impls as GPT (``dot`` XLA attention or the
   Pallas flash kernel with ``causal=False``);
-- a classification head for sequence-level fine-tuning plus an MLM head
-  for pretraining-style objectives;
+- a classification head for sequence-level fine-tuning;
 - synthetic class-dependent token data for hermetic learning tests;
 - Megatron-style partition rules (qkv/mlp-in column, proj/mlp-out row)
   reusable by SpmdStrategy for tensor-parallel fine-tunes.
@@ -26,8 +25,8 @@ import optax
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
-from ray_lightning_tpu.core.data import ArrayDataset, DataLoader
-from ray_lightning_tpu.core.module import LightningModule
+from ray_lightning_tpu.core.data import ArrayDataset
+from ray_lightning_tpu.models.common import ClassificationModule
 from ray_lightning_tpu.ops.attention import MultiHeadAttention
 
 
@@ -140,7 +139,7 @@ def synthetic_classification(n: int, cfg: BertConfig,
     return ArrayDataset(tokens.astype(np.int32), labels.astype(np.int32))
 
 
-class BertLightningModule(LightningModule):
+class BertLightningModule(ClassificationModule):
     """Sequence-classification fine-tune (BASELINE config #4 workload)."""
 
     def __init__(self, config: "BertConfig | str" = "tiny",
@@ -166,48 +165,8 @@ class BertLightningModule(LightningModule):
         sched = optax.linear_schedule(0.0, self.lr, self.warmup_steps)
         return optax.adamw(sched, weight_decay=self.weight_decay)
 
-    def _logits_loss_acc(self, ctx, batch):
-        tokens, labels = batch
-        logits = ctx.apply(tokens, not ctx.training)
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits, labels).mean()
-        acc = jnp.mean((jnp.argmax(logits, -1) == labels)
-                       .astype(jnp.float32))
-        return logits, loss, acc
+    def compute_logits(self, ctx, tokens):
+        return ctx.apply(tokens, not ctx.training)
 
-    def training_step(self, ctx, batch):
-        _, loss, acc = self._logits_loss_acc(ctx, batch)
-        ctx.log("loss", loss)
-        ctx.log("train_accuracy", acc)
-        return loss
-
-    def validation_step(self, ctx, batch):
-        _, loss, acc = self._logits_loss_acc(ctx, batch)
-        ctx.log("val_loss", loss)
-        ctx.log("val_accuracy", acc)
-
-    def test_step(self, ctx, batch):
-        _, loss, acc = self._logits_loss_acc(ctx, batch)
-        ctx.log("test_loss", loss)
-        ctx.log("test_accuracy", acc)
-
-    def predict_step(self, ctx, batch):
-        tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
-        return jnp.argmax(ctx.apply(tokens, True), -1)
-
-    def _loader(self, n, seed, shuffle=False):
-        return DataLoader(synthetic_classification(n, self.config, seed),
-                          batch_size=self.batch_size, shuffle=shuffle,
-                          drop_last=True)
-
-    def train_dataloader(self):
-        return self._loader(self.train_size, 0, shuffle=True)
-
-    def val_dataloader(self):
-        return self._loader(self.val_size, 1)
-
-    def test_dataloader(self):
-        return self._loader(self.val_size, 2)
-
-    def predict_dataloader(self):
-        return self.test_dataloader()
+    def make_dataset(self, n, seed):
+        return synthetic_classification(n, self.config, seed)

@@ -1,0 +1,81 @@
+"""Shared scaffolding for classification-style LightningModules.
+
+BERT fine-tuning, ResNet image classification (and any user model with
+the logits→cross-entropy→accuracy shape) differ only in how they compute
+logits and materialize data; the step/loader plumbing is identical.
+Subclasses implement :meth:`compute_logits` and :meth:`make_dataset`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+from ray_lightning_tpu.core.data import DataLoader
+from ray_lightning_tpu.core.module import LightningModule
+
+
+class ClassificationModule(LightningModule):
+    """Cross-entropy classification over ``(inputs, int_labels)`` batches.
+
+    Subclass contract:
+      - ``compute_logits(ctx, inputs) -> [B, num_classes]``
+      - ``make_dataset(n, seed) -> ArrayDataset`` of (inputs, labels)
+      - attributes ``batch_size``, ``train_size``, ``val_size``
+    """
+
+    def compute_logits(self, ctx, inputs):
+        raise NotImplementedError
+
+    def make_dataset(self, n: int, seed: int):
+        raise NotImplementedError
+
+    # -- steps ------------------------------------------------------------
+
+    def _logits_loss_acc(self, ctx, batch):
+        inputs, labels = batch
+        logits = self.compute_logits(ctx, inputs)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels)
+                       .astype(jnp.float32))
+        return logits, loss, acc
+
+    def training_step(self, ctx, batch):
+        _, loss, acc = self._logits_loss_acc(ctx, batch)
+        ctx.log("loss", loss)
+        ctx.log("train_accuracy", acc)
+        return loss
+
+    def validation_step(self, ctx, batch):
+        _, loss, acc = self._logits_loss_acc(ctx, batch)
+        ctx.log("val_loss", loss)
+        ctx.log("val_accuracy", acc)
+
+    def test_step(self, ctx, batch):
+        _, loss, acc = self._logits_loss_acc(ctx, batch)
+        ctx.log("test_loss", loss)
+        ctx.log("test_accuracy", acc)
+
+    def predict_step(self, ctx, batch):
+        inputs = batch[0] if isinstance(batch, (tuple, list)) else batch
+        return jnp.argmax(self.compute_logits(ctx, inputs), -1)
+
+    # -- loaders ----------------------------------------------------------
+
+    def _loader(self, n, seed, shuffle=False):
+        return DataLoader(self.make_dataset(n, seed),
+                          batch_size=self.batch_size, shuffle=shuffle,
+                          drop_last=True)
+
+    def train_dataloader(self):
+        return self._loader(self.train_size, 0, shuffle=True)
+
+    def val_dataloader(self):
+        return self._loader(self.val_size, 1)
+
+    def test_dataloader(self):
+        return self._loader(self.val_size, 2)
+
+    def predict_dataloader(self):
+        return self.test_dataloader()

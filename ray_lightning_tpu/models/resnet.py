@@ -25,8 +25,8 @@ import numpy as np
 import optax
 from flax import linen as nn
 
-from ray_lightning_tpu.core.data import ArrayDataset, DataLoader
-from ray_lightning_tpu.core.module import LightningModule
+from ray_lightning_tpu.core.data import ArrayDataset
+from ray_lightning_tpu.models.common import ClassificationModule
 
 
 @dataclasses.dataclass(frozen=True)
@@ -143,7 +143,7 @@ def synthetic_cifar10(n: int, seed: int = 0) -> ArrayDataset:
     return ArrayDataset(x.astype(np.float32), labels.astype(np.int32))
 
 
-class ResNetLightningModule(LightningModule):
+class ResNetLightningModule(ClassificationModule):
     """Image-classification module (BASELINE config #2 workload)."""
 
     def __init__(self, config: "ResNetConfig | str" = "resnet50",
@@ -170,47 +170,8 @@ class ResNetLightningModule(LightningModule):
             optax.add_decayed_weights(self.weight_decay),
             optax.sgd(self.lr, momentum=self.momentum, nesterov=True))
 
-    def _logits_loss_acc(self, ctx, batch):
-        x, y = batch
-        logits = ctx.apply(x, ctx.training)
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits, y).mean()
-        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
-        return logits, loss, acc
+    def compute_logits(self, ctx, images):
+        return ctx.apply(images, ctx.training)
 
-    def training_step(self, ctx, batch):
-        _, loss, acc = self._logits_loss_acc(ctx, batch)
-        ctx.log("loss", loss)
-        ctx.log("train_accuracy", acc)
-        return loss
-
-    def validation_step(self, ctx, batch):
-        _, loss, acc = self._logits_loss_acc(ctx, batch)
-        ctx.log("val_loss", loss)
-        ctx.log("val_accuracy", acc)
-
-    def test_step(self, ctx, batch):
-        _, loss, acc = self._logits_loss_acc(ctx, batch)
-        ctx.log("test_loss", loss)
-        ctx.log("test_accuracy", acc)
-
-    def predict_step(self, ctx, batch):
-        x = batch[0] if isinstance(batch, (tuple, list)) else batch
-        return jnp.argmax(ctx.apply(x, False), -1)
-
-    def _loader(self, n, seed, shuffle=False):
-        return DataLoader(synthetic_cifar10(n, seed),
-                          batch_size=self.batch_size, shuffle=shuffle,
-                          drop_last=True)
-
-    def train_dataloader(self):
-        return self._loader(self.train_size, 0, shuffle=True)
-
-    def val_dataloader(self):
-        return self._loader(self.val_size, 1)
-
-    def test_dataloader(self):
-        return self._loader(self.val_size, 2)
-
-    def predict_dataloader(self):
-        return self.test_dataloader()
+    def make_dataset(self, n, seed):
+        return synthetic_cifar10(n, seed)

@@ -258,8 +258,31 @@ class SpmdStrategy(ShardingStrategy):
     def _rule_spec(self, mesh: Mesh, path: str, aval) -> P | None:
         for rx, spec in self.rules:
             if rx.search(path):
-                return spec
+                pruned = self._prune_spec(mesh, spec)
+                if any(e is not None for e in spec) and \
+                        not any(e is not None for e in pruned):
+                    # the rule only named axes this mesh lacks (e.g. a
+                    # 'tensor' rule on a (data, fsdp) mesh): treat as
+                    # unmatched so the param still reaches later rules /
+                    # the fsdp fallback instead of silently replicating
+                    continue
+                return pruned
         return None
+
+    @staticmethod
+    def _prune_spec(mesh: Mesh, spec: P) -> P:
+        """Drop axes the mesh does not have, so one rule set (written for
+        the full data/fsdp/sequence/tensor layout) works on any sub-mesh
+        — a rules entry P('tensor', None) on a (data, sequence) mesh
+        becomes P(None, None) instead of erroring."""
+        def keep(entry):
+            if entry is None:
+                return None
+            if isinstance(entry, (tuple, list)):
+                kept = tuple(a for a in entry if a in mesh.axis_names)
+                return kept if kept else None
+            return entry if entry in mesh.axis_names else None
+        return P(*(keep(e) for e in spec))
 
     def _fsdp_fallback(self, mesh: Mesh, aval) -> P:
         if "fsdp" in mesh.axis_names and mesh.shape["fsdp"] > 1 \

@@ -14,6 +14,7 @@ EXAMPLES = [
     "ray_lightning_tpu.examples.ray_ddp_tune",
     "ray_lightning_tpu.examples.ray_ddp_sharded_example",
     "ray_lightning_tpu.examples.ray_spmd_example",
+    "ray_lightning_tpu.examples.ray_longcontext_example",
 ]
 
 
