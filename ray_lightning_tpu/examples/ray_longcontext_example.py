@@ -19,7 +19,6 @@ import os
 
 
 def train(sequence: int = 4,
-          data: int = 1,
           model_size: str = "gpt2-small",
           seq_len: int = 8192,
           num_epochs: int = 1,
