@@ -53,6 +53,11 @@ class GPTConfig:
     remat: bool = True
     dtype: Any = jnp.bfloat16        # compute dtype; params stay fp32
     attention_impl: str = "auto"     # "auto" | "dot" | "flash" | "ring"
+    # >0: compute the LM loss with chunked_softmax_cross_entropy over this
+    # many row chunks instead of full fp32 logits — the memory opt-in for
+    # long-seq × large-vocab configs (ops/losses.py); 0 = fused full-vocab
+    # loss (faster when the logits fit, measured on v5e)
+    chunked_ce: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -70,8 +75,10 @@ CONFIGS = {
                             n_embd=768, remat=False),
     "gpt2-medium": GPTConfig(block_size=1024, n_layer=24, n_head=16,
                              n_embd=1024),
+    # 1.3B class: remat + chunked CE — at T=2048 the full fp32 logits
+    # alone would be ~1.6GB/example-batch; the chunked loss streams them
     "gpt2-1p3b": GPTConfig(block_size=2048, n_layer=24, n_head=32,
-                           n_embd=2048),
+                           n_embd=2048, chunked_ce=16),
 }
 
 
@@ -106,31 +113,50 @@ class Block(nn.Module):
 
 
 class GPT(nn.Module):
-    """Decoder-only transformer; ``__call__(tokens) -> logits``."""
+    """Decoder-only transformer; ``__call__(tokens) -> logits``.
+
+    ``hidden()`` exposes the pre-head representation so losses can chunk
+    the vocab projection (ops/losses.py) instead of materializing the
+    full fp32 [B·T, V] logits tensor — at V=50k that tensor dominates
+    HBM traffic in the loss.  setup-style so both methods share the
+    submodules; param paths are identical to the previous compact form.
+    """
 
     config: GPTConfig
 
-    @nn.compact
-    def __call__(self, idx, deterministic: bool = True):
+    def setup(self):
         cfg = self.config
-        B, T = idx.shape
-        wte = nn.Embed(cfg.vocab_size, cfg.n_embd, name="wte",
-                       dtype=cfg.dtype)
-        tok = wte(idx)
-        pos = self.param(
-            "wpe", nn.initializers.normal(0.02), (cfg.block_size, cfg.n_embd))
-        x = (tok + pos[:T].astype(cfg.dtype))
+        self.wte = nn.Embed(cfg.vocab_size, cfg.n_embd, name="wte",
+                            dtype=cfg.dtype)
+        self.wpe = self.param("wpe", nn.initializers.normal(0.02),
+                              (cfg.block_size, cfg.n_embd))
         block = Block
         if cfg.remat:
             # trade FLOPs for HBM: recompute block activations on backward
             block = nn.remat(Block, static_argnums=(2,))
-        for i in range(cfg.n_layer):
-            x = block(cfg, name=f"h{i}")(x, deterministic)
-        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        self.blocks = [block(cfg, name=f"h{i}")
+                       for i in range(cfg.n_layer)]
+        self.ln_f = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")
+
+    def hidden(self, idx, deterministic: bool = True):
+        """Pre-head representation ``[B, T, C]`` in the compute dtype."""
+        cfg = self.config
+        B, T = idx.shape
+        x = self.wte(idx) + self.wpe[:T].astype(cfg.dtype)
+        for blk in self.blocks:
+            x = blk(x, deterministic)
+        return self.ln_f(x)
+
+    @property
+    def embedding_table(self):
+        return self.wte.embedding
+
+    def __call__(self, idx, deterministic: bool = True):
+        x = self.hidden(idx, deterministic)
         # tied output head: attend promotes operands to the compute dtype
         # (bf16 on the MXU, fp32 accumulation implicit on TPU); logits
         # upcast to fp32 only for the loss softmax.
-        return wte.attend(x).astype(jnp.float32)
+        return self.wte.attend(x).astype(jnp.float32)
 
 
 def gpt_partition_rules(tensor_axis: str = "tensor") -> list[tuple[str, P]]:
@@ -197,6 +223,15 @@ class GPTLightningModule(LightningModule):
 
     def _loss(self, ctx, batch):
         x, y = batch
+        if self.config.chunked_ce > 0:
+            # memory-lean loss: never materialize full fp32 logits
+            # (ops/losses.py; the opt-in for long-seq × 50k-vocab configs)
+            from ray_lightning_tpu.ops.losses import (
+                chunked_softmax_cross_entropy)
+            h = ctx.apply(x, not ctx.training, method=GPT.hidden)
+            table = ctx.apply(method=lambda m: m.embedding_table)
+            return chunked_softmax_cross_entropy(
+                h, table, y, self.config.chunked_ce)
         logits = ctx.apply(x, not ctx.training)
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, y).mean()
