@@ -75,16 +75,37 @@ def resolve_refs(args: tuple, kwargs: Optional[dict] = None):
 
 class LocalActorHandle(ActorHandle):
     def __init__(self, backend: "LocalBackend", actor_id: str,
-                 proc: subprocess.Popen):
+                 proc: Optional[subprocess.Popen] = None,
+                 log_path: Optional[str] = None):
         self.actor_id = actor_id
         self._backend = backend
+        # None only during create_actor: the handle registers in the
+        # backend BEFORE the subprocess spawns, so a worker whose hello
+        # races ahead of the driver thread still finds its handle
         self._proc = proc
+        self.log_path = log_path  # captured worker stdout+stderr
         self._conn: Optional[Connection] = None
         self._conn_ready = threading.Event()
         self._pending: dict[str, Future] = {}
         self._lock = threading.Lock()
         self._dead = False
         self._death_error: Optional[BaseException] = None
+
+    def _log_tail(self, max_bytes: int = 4096) -> str:
+        """Tail of the worker's captured output, for failure diagnostics
+        (Ray surfaces worker logs the same way)."""
+        if not self.log_path:
+            return ""
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - max_bytes))
+                tail = f.read().decode(errors="replace").strip()
+            return f"\n--- worker log tail ({self.log_path}) ---\n{tail}" \
+                if tail else ""
+        except OSError:
+            return ""
 
     # -- wiring (called by backend accept loop) -------------------------
 
@@ -120,7 +141,9 @@ class LocalActorHandle(ActorHandle):
             self._fail_pending(
                 RemoteActorError(
                     f"actor {self.actor_id} died (connection lost); "
-                    f"returncode={self._proc.poll()}"))
+                    f"returncode="
+                    f"{self._proc.poll() if self._proc else 'unknown'}"
+                    f"{self._log_tail()}"))
 
     def _fail_pending(self, err: BaseException) -> None:
         self._dead = True
@@ -140,8 +163,11 @@ class LocalActorHandle(ActorHandle):
                 f"actor {self.actor_id} is dead"))
             return fut
         if not self._conn_ready.wait(timeout=120):
+            rc = self._proc.poll() if self._proc else None
             fut.set_error(RemoteActorError(
-                f"actor {self.actor_id} never connected"))
+                f"actor {self.actor_id} never connected; "
+                f"{'process alive' if rc is None else f'returncode={rc}'}"
+                f"{self._log_tail()}"))
             return fut
         call_id = uuid.uuid4().hex
         with self._lock:
@@ -163,6 +189,8 @@ class LocalActorHandle(ActorHandle):
                 self._conn.send({"type": "shutdown"})
             except (ConnectionError, OSError):
                 pass
+        if self._proc is None:
+            return
         try:
             self._proc.terminate()
             self._proc.wait(timeout=5)
@@ -204,14 +232,33 @@ class LocalBackend(ClusterBackend):
                 sock, _ = self._listener.accept()
             except OSError:
                 return
-            conn = Connection(sock)
+            # read the hello off-thread with a deadline: a connection
+            # whose peer dies between connect and hello must not block
+            # every other worker's attach (observed as spurious
+            # "never connected" timeouts under load)
+            threading.Thread(target=self._handshake, args=(sock,),
+                             daemon=True, name="rlt-handshake").start()
+
+    def _handshake(self, sock) -> None:
+        sock.settimeout(60)
+        conn = Connection(sock)
+        try:
+            hello = conn.recv()
+        except (ConnectionError, OSError, TimeoutError):
             try:
-                hello = conn.recv()
-            except (ConnectionError, OSError):
-                continue
-            handle = self._actors.get(hello.get("actor_id"))
-            if handle is not None:
-                handle._attach(conn)
+                sock.close()
+            except OSError:
+                pass
+            return
+        sock.settimeout(None)
+        actor_id = hello.get("actor_id")
+        handle = self._actors.get(actor_id)
+        if handle is not None:
+            handle._attach(conn)
+        else:
+            print(f"[rlt-backend] dropping hello from unknown actor "
+                  f"{actor_id!r} (known: {sorted(self._actors)})",
+                  file=sys.stderr, flush=True)
 
     def _queue_push(self, item: Any) -> None:
         with self._queue_lock:
@@ -235,11 +282,26 @@ class LocalBackend(ClusterBackend):
         child_env["RLT_DRIVER_SOCKET"] = self._sock_path
         child_env["RLT_ACTOR_ID"] = actor_id
         child_env["RLT_ACTOR_SPEC"] = spec_path
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_lightning_tpu.cluster.worker_main"],
-            env=child_env, cwd=os.getcwd())
-        handle = LocalActorHandle(self, actor_id, proc)
+        # capture worker output per actor; surfaced in failure errors
+        # (the log-tail diagnostics Ray gives for dead workers)
+        log_path = os.path.join(self._dir, f"{actor_id}.log")
+        log_file = open(log_path, "ab")
+        # register BEFORE spawning: on a loaded box the worker's hello
+        # can reach the handshake thread before this thread resumes
+        # after Popen, and an unregistered id would drop the connection
+        handle = LocalActorHandle(self, actor_id, log_path=log_path)
         self._actors[actor_id] = handle
+        try:
+            handle._proc = subprocess.Popen(
+                [sys.executable, "-m",
+                 "ray_lightning_tpu.cluster.worker_main"],
+                env=child_env, cwd=os.getcwd(),
+                stdout=log_file, stderr=subprocess.STDOUT)
+        except BaseException:
+            self._actors.pop(actor_id, None)
+            raise
+        finally:
+            log_file.close()  # the child holds its own descriptor
         return handle
 
     # -- shared-memory object store ---------------------------------------

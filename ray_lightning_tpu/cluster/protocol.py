@@ -15,6 +15,7 @@ play for the reference, SURVEY.md §2.2).  Messages are dicts with a
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
@@ -75,7 +76,17 @@ def find_free_port(host: str = "") -> int:
 
 
 def node_ip() -> str:
-    """Best-effort IP of this node (RayExecutor.get_node_ip analog)."""
+    """Best-effort IP of this node (RayExecutor.get_node_ip analog).
+
+    ``RLT_NODE_IP_OVERRIDE`` fakes the answer per process — the
+    single-machine stand-in for multi-node topology, as the reference
+    fakes node IPs "1"/"2" to test rank assignment (test_ddp.py:78-112)
+    and spins two raylets on one box (ray.cluster_utils.Cluster,
+    test_ddp.py:52-60).
+    """
+    override = os.environ.get("RLT_NODE_IP_OVERRIDE")
+    if override:
+        return override
     try:
         with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
             s.connect(("8.8.8.8", 80))

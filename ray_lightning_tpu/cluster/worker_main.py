@@ -13,6 +13,7 @@ from __future__ import annotations
 import os
 import socket
 import sys
+import time
 import traceback
 
 import cloudpickle
@@ -21,16 +22,25 @@ from ray_lightning_tpu.cluster import worker_state
 from ray_lightning_tpu.cluster.protocol import Connection
 
 
+def _trace(msg: str) -> None:
+    """Milestone line in the worker's captured log (cluster/local.py
+    redirects stdout there); read back by _log_tail on failures."""
+    print(f"[worker {os.getpid()} {time.strftime('%H:%M:%S')}] {msg}",
+          flush=True)
+
+
 def main() -> int:
     sock_path = os.environ["RLT_DRIVER_SOCKET"]
     actor_id = os.environ["RLT_ACTOR_ID"]
     spec_path = os.environ["RLT_ACTOR_SPEC"]
+    _trace(f"start {actor_id}")
 
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     sock.connect(sock_path)
     _conn = Connection(sock)
     worker_state.set_conn(_conn)
     _conn.send({"type": "hello", "actor_id": actor_id})
+    _trace("hello sent")
 
     with open(spec_path, "rb") as f:
         actor_cls, args, kwargs = cloudpickle.loads(f.read())
@@ -40,11 +50,13 @@ def main() -> int:
         _conn.send({"type": "result", "call_id": "__construct__",
                     "ok": False, "error": traceback.format_exc()})
         return 1
+    _trace("actor constructed; serving")
 
     while True:
         try:
             msg = _conn.recv()
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError) as e:
+            _trace(f"connection closed ({type(e).__name__}: {e}); exiting")
             return 0
         kind = msg.get("type")
         if kind == "shutdown":

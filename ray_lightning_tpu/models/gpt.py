@@ -229,7 +229,10 @@ class GPTLightningModule(LightningModule):
             from ray_lightning_tpu.ops.losses import (
                 chunked_softmax_cross_entropy)
             h = ctx.apply(x, not ctx.training, method=GPT.hidden)
-            table = ctx.apply(method=lambda m: m.embedding_table)
+            # read the tied table from params directly: a second
+            # ctx.apply would consume an extra dropout-RNG split and
+            # change training trajectories vs the full-vocab path
+            table = ctx.params["wte"]["embedding"]
             return chunked_softmax_cross_entropy(
                 h, table, y, self.config.chunked_ce)
         logits = ctx.apply(x, not ctx.training)

@@ -20,9 +20,13 @@ so the default loss path stays full-vocab.
 
 from __future__ import annotations
 
+import logging
+
 import jax
 import jax.numpy as jnp
 import optax
+
+_log = logging.getLogger(__name__)
 
 
 def chunked_softmax_cross_entropy(hidden, table, targets,
@@ -36,9 +40,17 @@ def chunked_softmax_cross_entropy(hidden, table, targets,
     """
     B, T, D = hidden.shape
     rows_total = B * T
+    requested = n_chunks
     n_chunks = max(1, min(n_chunks, rows_total))
     while rows_total % n_chunks:
         n_chunks -= 1
+    if n_chunks < min(requested, rows_total):
+        # silent degradation would reintroduce the very logits-memory
+        # spike this function exists to avoid — make it visible
+        _log.warning(
+            "chunked CE: %d rows not divisible into %d chunks; using %d "
+            "(peak logits memory grows by the same factor).",
+            rows_total, requested, n_chunks)
     rows = rows_total // n_chunks
 
     h = hidden.reshape(n_chunks, rows, D)

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import logging
 import os
+import uuid
 from typing import Any, Callable, Optional
 
 from ray_lightning_tpu.cluster.backend import get_backend
@@ -192,6 +193,10 @@ class RayXlaPlugin(ExecutionPlugin):
             n = self.devices_per_worker or 1
             env["XLA_FLAGS"] = host_device_count_flags(n)
             env["RLT_NUM_LOCAL_DEVICES"] = str(n)
+            # CPU workers must never touch a TPU attach/tunnel path the
+            # driver environment may carry (single-client tunnels crash
+            # concurrent registrants); empty disables such hooks
+            env["PALLAS_AXON_POOL_IPS"] = ""
         env.update(self.worker_env)
         return env
 
@@ -204,12 +209,16 @@ class RayXlaPlugin(ExecutionPlugin):
         backend = get_backend()
         self._backend = backend
         base_env = self._worker_env_base()
+        # unique per fit: reusing names across fits in one driver process
+        # lets a late/stale connection from a previous run race the new
+        # worker's attach
+        run_tag = uuid.uuid4().hex[:8]
         self._workers = [
             backend.create_actor(
                 RLTExecutor,
                 env=base_env,
                 resources=self._worker_resources(),
-                name=f"rlt-worker-{os.getpid()}-{i}",
+                name=f"rlt-worker-{os.getpid()}-{run_tag}-{i}",
             )
             for i in range(self.num_workers)
         ]

@@ -1,0 +1,40 @@
+"""Fake multi-node topology on one machine: actors claim distinct node
+IPs via RLT_NODE_IP_OVERRIDE and the real RPC path feeds the plugin's
+rank-assignment — the single-box analog of the reference's two-raylet
+cluster fixture (ray.cluster_utils.Cluster, test_ddp.py:52-60) and its
+fake-IP rank tests (test_ddp.py:78-112)."""
+
+from ray_lightning_tpu.cluster.executor import RLTExecutor
+from ray_lightning_tpu.cluster.local import LocalBackend
+from ray_lightning_tpu.plugins.xla import RayXlaPlugin
+from ray_lightning_tpu.util import process_results
+
+
+def test_fake_two_node_topology_end_to_end():
+    backend = LocalBackend()
+    try:
+        # 4 workers: ranks 0,2 on "node 1"; ranks 1,3 on "node 2"
+        actors = [
+            backend.create_actor(
+                RLTExecutor,
+                env={"RLT_NODE_IP_OVERRIDE": "1" if i % 2 == 0 else "2"},
+                name=f"fake-node-{i}")
+            for i in range(4)
+        ]
+        info = process_results(
+            [a.call("get_node_and_device_info") for a in actors], backend)
+        assert [d["ip"] for d in info] == ["1", "2", "1", "2"]
+
+        ranks = RayXlaPlugin._assign_local_ranks(info)
+        assert ranks[0] == (0, 0)
+        assert ranks[2] == (0, 1)
+        assert ranks[1] == (1, 0)
+        assert ranks[3] == (1, 1)
+
+        # the coordinator-address plumbing also sees the faked IP
+        ip = actors[0].call("get_node_ip").result(timeout=60)
+        assert ip == "1"
+        for a in actors:
+            a.kill()
+    finally:
+        backend.shutdown()
