@@ -9,10 +9,13 @@ One home for the attention path selection used by every model family
   softmax, the fast path on TPU;
 - ring attention (parallel/ring.py) — sequence-parallel flash whose KV
   blocks rotate around the mesh;
-- :func:`auto_attention` — trace-time choice: flash on single-device
-  TPU (measured faster at every seq length on v5e, and the only path at
-  T≥8k), dot elsewhere (CPU tests; multi-device meshes, where the
-  kernel needs the ring/shard_map composition instead).
+- :func:`auto_attention` — trace-time choice: on TPU, the flash kernel
+  (measured faster at every seq length on v5e, and the only path at
+  T≥8k) — directly on one chip, via :func:`sharded_flash_attention`'s
+  shard_map over batch/head axes on multi-chip meshes whose shapes
+  divide evenly; dot attention elsewhere (CPU tests; sequence-sharded
+  meshes belong to ring attention; uneven shapes stay on GSPMD dot,
+  which pads).
 
 :class:`MultiHeadAttention` carries the qkv/attend/proj plumbing shared
 by the model families; its submodule names (``qkv``, ``proj``) are part
@@ -51,10 +54,53 @@ def dot_product_attention(q, k, v, *, causal: bool = True,
 
 def auto_attention(q, k, v, **kw):
     """Trace-time attention choice (see module docstring)."""
-    if jax.devices()[0].platform == "tpu" and jax.device_count() == 1:
-        from ray_lightning_tpu.ops.flash_attention import flash_attention
+    if jax.devices()[0].platform != "tpu":
+        return dot_product_attention(q, k, v, **kw)
+    from ray_lightning_tpu.ops.flash_attention import flash_attention
+    if jax.device_count() == 1:
         return flash_attention(q, k, v, **kw)
+    from ray_lightning_tpu.parallel.mesh import (
+        data_and_tensor_axes, get_current_mesh)
+    mesh = get_current_mesh()
+    if mesh is not None and mesh.shape.get("sequence", 1) == 1:
+        # multi-chip without sequence sharding: batch rides data/fsdp,
+        # heads ride tensor — both per-device under shard_map, so the
+        # kernel applies unchanged on each device's local shard.  Only
+        # when shapes divide evenly: shard_map has no padding, GSPMD
+        # dot does — uneven configs keep working via the dot path.
+        dp, tensor = data_and_tensor_axes(mesh)
+        dp_size = 1
+        for a in (dp or ()):
+            dp_size *= mesh.shape[a]
+        t_size = mesh.shape[tensor] if tensor else 1
+        if q.shape[0] % dp_size == 0 and q.shape[2] % t_size == 0:
+            return sharded_flash_attention(q, k, v, mesh=mesh, **kw)
+    # sequence-sharded meshes use ring attention (attention_impl="ring");
+    # no mesh / uneven shapes → the XLA path, which GSPMD partitions
     return dot_product_attention(q, k, v, **kw)
+
+
+def sharded_flash_attention(q, k, v, *, mesh, causal: bool = True,
+                            dtype=jnp.bfloat16, **kw):
+    """Flash attention over a (data[, fsdp][, tensor]) mesh: shard_map
+    over batch (data/fsdp) and heads (tensor); each device runs the
+    Pallas kernel on its local [b_local, T, h_local, D] block.  No
+    collectives are needed — attention mixes only T and D, which stay
+    unsharded here (sequence sharding is ring attention's job)."""
+    from ray_lightning_tpu.ops.flash_attention import flash_attention
+    from ray_lightning_tpu.parallel.mesh import data_and_tensor_axes
+    from jax.sharding import PartitionSpec as P
+
+    dp, tensor = data_and_tensor_axes(mesh)
+    spec = P(dp, None, tensor, None)
+
+    def inner(ql, kl, vl):
+        return flash_attention(ql, kl, vl, causal=causal, dtype=dtype,
+                               **kw)
+
+    fn = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)
 
 
 def resolve_attention(impl: str) -> Callable:

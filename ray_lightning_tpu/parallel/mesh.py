@@ -79,6 +79,15 @@ def mesh_axis_size(mesh: Mesh, *names: str) -> int:
 _MESH_TLS = threading.local()
 
 
+def data_and_tensor_axes(mesh: Mesh):
+    """(data_axes, tensor_axis) present in ``mesh`` — the batch/head
+    sharding layout shared by the attention shard_map paths
+    (ops/attention.py, parallel/ring.py)."""
+    dp = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names) or None
+    tensor = "tensor" if "tensor" in mesh.axis_names else None
+    return dp, tensor
+
+
 def set_current_mesh(mesh: Mesh | None) -> None:
     _MESH_TLS.mesh = mesh
 

@@ -131,8 +131,8 @@ def ring_attention(q, k, v, *, causal: bool = True, dtype=jnp.bfloat16,
         return blockwise_attention(q, k, v, causal=causal, dtype=dtype,
                                    sm_scale=scale)
 
-    dp = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names) or None
-    tensor = "tensor" if "tensor" in mesh.axis_names else None
+    from ray_lightning_tpu.parallel.mesh import data_and_tensor_axes
+    dp, tensor = data_and_tensor_axes(mesh)
     spec = P(dp, axis_name, tensor, None)
     inner = functools.partial(_ring_inner, axis_name=axis_name,
                               causal=causal, scale=scale, dtype=dtype,
