@@ -38,6 +38,7 @@ from ray_lightning_tpu.core.callbacks import (
     ModelCheckpoint,
 )
 from ray_lightning_tpu.utils.seed import seed_everything
+from ray_lightning_tpu.utils.logger import CSVLogger
 from ray_lightning_tpu.utils.profiling import (
     JaxProfilerCallback,
     ThroughputMonitor,
@@ -60,6 +61,7 @@ __all__ = [
     "EarlyStopping",
     "ModelCheckpoint",
     "seed_everything",
+    "CSVLogger",
     "ThroughputMonitor",
     "JaxProfilerCallback",
     "RayXlaPlugin",

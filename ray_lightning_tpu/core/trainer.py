@@ -108,6 +108,8 @@ class Trainer:
         self.seed = seed
         self.resume_from_checkpoint = resume_from_checkpoint
         self.use_distributed_sampler = use_distributed_sampler
+        from ray_lightning_tpu.utils.logger import resolve_logger
+        self.logger = resolve_logger(logger, self.default_root_dir)
 
         # execution plugin (LocalPlugin unless a distributed one is given)
         from ray_lightning_tpu.plugins.base import LocalPlugin
@@ -510,13 +512,25 @@ class Trainer:
             val = float(jax.device_get(v))
             self.callback_metrics[k] = val
             self.logged_metrics[k] = val
+        if self.logger is not None and self.is_global_zero and metrics:
+            self.logger.log_metrics(
+                {k: self.logged_metrics[k] for k in metrics},
+                self.global_step)
 
     def _flush_epoch_metrics(self) -> None:
+        flushed = {}
         for k, vals in self._epoch_metric_acc.items():
             arr = np.asarray(jax.device_get(vals), dtype=np.float64)
-            self.callback_metrics[k] = float(arr.mean())
+            self.callback_metrics[k] = flushed[k] = float(arr.mean())
             self.logged_metrics[k] = float(arr[-1])
         self._epoch_metric_acc = {}
+        if self.logger is not None and self.is_global_zero and flushed:
+            # _epoch suffix: step-level rows already carry the bare names
+            # at this same step; suffixing disambiguates mean-over-epoch
+            # from last-step values (PL's convention)
+            self.logger.log_metrics(
+                {f"{k}_epoch": v for k, v in flushed.items()},
+                self.global_step)
 
     def log_metric(self, name: str, value) -> None:
         """Record a host-side scalar into ``callback_metrics`` (public
@@ -592,6 +606,8 @@ class Trainer:
         if not self.sanity_checking:
             self.callback_metrics.update(means)
             self.logged_metrics.update(means)
+            if self.logger is not None and self.is_global_zero and means:
+                self.logger.log_metrics(means, self.global_step)
 
         if stage == "validate":
             module.on_validation_epoch_end()

@@ -60,7 +60,7 @@ def auto_attention(q, k, v, **kw):
     if jax.device_count() == 1:
         return flash_attention(q, k, v, **kw)
     from ray_lightning_tpu.parallel.mesh import (
-        data_and_tensor_axes, get_current_mesh)
+        get_current_mesh, mesh_axis_size)
     mesh = get_current_mesh()
     if mesh is not None and mesh.shape.get("sequence", 1) == 1:
         # multi-chip without sequence sharding: batch rides data/fsdp,
@@ -68,11 +68,8 @@ def auto_attention(q, k, v, **kw):
         # kernel applies unchanged on each device's local shard.  Only
         # when shapes divide evenly: shard_map has no padding, GSPMD
         # dot does — uneven configs keep working via the dot path.
-        dp, tensor = data_and_tensor_axes(mesh)
-        dp_size = 1
-        for a in (dp or ()):
-            dp_size *= mesh.shape[a]
-        t_size = mesh.shape[tensor] if tensor else 1
+        dp_size = mesh_axis_size(mesh, "data", "fsdp")
+        t_size = mesh_axis_size(mesh, "tensor")
         if q.shape[0] % dp_size == 0 and q.shape[2] % t_size == 0:
             return sharded_flash_attention(q, k, v, mesh=mesh, **kw)
     # sequence-sharded meshes use ring attention (attention_impl="ring");

@@ -1,0 +1,97 @@
+"""Metrics loggers.
+
+The reference inherits PL's logger stack (metrics files under the
+trainer's root dir; rank-zero gating via ``rank_zero_only.rank``,
+ray_ddp.py:405).  Here :class:`CSVLogger` is the built-in equivalent of
+PL's CSVLogger: one ``metrics.csv`` under ``<root>/logs/``, a row per
+logging event, columns unioned across events.  ``Trainer(logger=True)``
+(the default) installs it; ``logger=False`` disables; any object with a
+``log_metrics(dict, step)`` method slots in as a custom logger.
+
+Rank-zero gating happens in the trainer (only rank 0's logger writes),
+so files on a shared FS are written once per run, like the reference's
+rank-zero-gated PL loggers.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+
+class CSVLogger:
+    """Append-only CSV metrics log (PL CSVLogger analog).
+
+    O(1) memory: rows append straight to disk; when the column set grows
+    (e.g. the first val_* metrics after an epoch) the existing file is
+    read back once and rewritten under the new header, so late-appearing
+    metrics still land in one coherent table.
+    """
+
+    def __init__(self, save_dir: str, name: str = "logs"):
+        self.save_dir = save_dir
+        self.name = name
+        self._fields: list[str] = ["step"]
+        self._started = False
+
+    @property
+    def log_dir(self) -> str:
+        return os.path.join(self.save_dir, self.name)
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.log_dir, "metrics.csv")
+
+    def log_metrics(self, metrics: dict, step: int) -> None:
+        row = {"step": int(step)}
+        for k, v in metrics.items():
+            try:
+                row[k] = float(v)
+            except (TypeError, ValueError):
+                continue
+        new_fields = [k for k in row if k not in self._fields]
+        if new_fields:
+            self._fields.extend(new_fields)
+            # schema grew (rare; e.g. first val_* after an epoch): fold
+            # the existing file into the new header.  Steady state is an
+            # O(1)-memory append — no rows are retained in memory.
+            self._rewrite_with_new_header()
+        os.makedirs(self.log_dir, exist_ok=True)
+        mode = "a" if self._started else "w"
+        with open(self.path, mode, newline="") as f:
+            writer = csv.DictWriter(f, fieldnames=self._fields, restval="")
+            if mode == "w":
+                writer.writeheader()
+            writer.writerow(row)
+        self._started = True
+
+    def _rewrite_with_new_header(self) -> None:
+        if not self._started or not os.path.exists(self.path):
+            return
+        with open(self.path, newline="") as f:
+            old_rows = list(csv.DictReader(f))
+        with open(self.path, "w", newline="") as f:
+            writer = csv.DictWriter(f, fieldnames=self._fields, restval="")
+            writer.writeheader()
+            for r in old_rows:
+                writer.writerow(r)
+
+    def finalize(self) -> None:
+        """Everything is flushed on write; nothing buffered."""
+
+
+def resolve_logger(logger, default_root_dir: str):
+    """Trainer's ``logger=`` argument → a logger object or None.
+
+    True → CSVLogger under the root dir; False/None → no logging;
+    anything with ``log_metrics`` → used as-is.
+    """
+    if logger is True:
+        return CSVLogger(default_root_dir)
+    if not logger:
+        return None
+    if hasattr(logger, "log_metrics"):
+        return logger
+    raise TypeError(
+        f"logger must be True/False or expose log_metrics(dict, step); "
+        f"got {type(logger).__name__}")
