@@ -13,7 +13,9 @@ from ray_lightning_tpu.models.bert import (
     BertClassifier,
     BertConfig,
     BertEncoder,
+    BertForMaskedLM,
     BertLightningModule,
+    BertMLMModule,
 )
 
 __all__ = [
@@ -30,4 +32,6 @@ __all__ = [
     "BertConfig",
     "BertEncoder",
     "BertLightningModule",
+    "BertForMaskedLM",
+    "BertMLMModule",
 ]

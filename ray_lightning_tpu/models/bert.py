@@ -19,13 +19,15 @@ from __future__ import annotations
 import dataclasses
 from typing import Any
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
-from ray_lightning_tpu.core.data import ArrayDataset
+from ray_lightning_tpu.core.data import ArrayDataset, DataLoader
+from ray_lightning_tpu.core.module import LightningModule
 from ray_lightning_tpu.models.common import ClassificationModule
 from ray_lightning_tpu.ops.attention import MultiHeadAttention
 
@@ -114,6 +116,89 @@ class BertClassifier(nn.Module):
                         name="classifier")(pooled)
 
 
+class BertForMaskedLM(nn.Module):
+    """Masked-LM head over the encoder: ``[B, T] -> [B, T, V]`` logits
+    (fp32 for the loss softmax; the matmul runs in the compute dtype)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, idx, deterministic: bool = True):
+        cfg = self.config
+        h = BertEncoder(cfg, name="encoder")(idx, deterministic)
+        return nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
+                        name="mlm_head")(h).astype(jnp.float32)
+
+
+class BertMLMModule(LightningModule):
+    """Masked-LM pretraining (BERT's pretext task, TPU-first).
+
+    Masking happens *inside the compiled step* with the step's PRNG
+    stream — static shapes, no host-side mask generation per batch: a
+    Bernoulli(mask_prob) mask selects positions, masked inputs are
+    replaced by the reserved last vocab id, and the loss averages
+    cross-entropy over masked positions only.
+    """
+
+    def __init__(self, config: "BertConfig | str" = "tiny",
+                 lr: float = 1e-4, weight_decay: float = 0.01,
+                 mask_prob: float = 0.15, batch_size: int = 8,
+                 train_size: int = 256, val_size: int = 64):
+        super().__init__()
+        if isinstance(config, str):
+            config = CONFIGS[config]
+        self.config = config
+        self.save_hyperparameters("lr", "mask_prob", "batch_size")
+        self.lr = lr
+        self.weight_decay = weight_decay
+        self.mask_prob = mask_prob
+        self.batch_size = batch_size
+        self.train_size = train_size
+        self.val_size = val_size
+
+    def configure_model(self):
+        return BertForMaskedLM(self.config)
+
+    def configure_optimizers(self):
+        return optax.adamw(self.lr, weight_decay=self.weight_decay)
+
+    def _mlm_loss(self, ctx, tokens, rng):
+        mask_token = self.config.vocab_size - 1
+        mask = jax.random.bernoulli(rng, self.mask_prob, tokens.shape)
+        inputs = jnp.where(mask, mask_token, tokens)
+        logits = ctx.apply(inputs, not ctx.training)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, tokens)
+        weights = mask.astype(jnp.float32)
+        return (ce * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+
+    def training_step(self, ctx, batch):
+        tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
+        loss = self._mlm_loss(ctx, tokens, ctx.make_rng())
+        ctx.log("loss", loss)
+        return loss
+
+    def validation_step(self, ctx, batch):
+        tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
+        # fixed eval mask: deterministic metric across runs
+        ctx.log("val_loss", self._mlm_loss(
+            ctx, tokens, jax.random.PRNGKey(0)))
+
+    def _loader(self, n, seed, shuffle=False):
+        from ray_lightning_tpu.models.gpt import synthetic_lm_dataset
+        ds = synthetic_lm_dataset(n, self.config.max_len,
+                                  self.config.vocab_size - 1, seed)
+        tokens = ds.take(np.arange(len(ds)))[0]  # inputs only
+        return DataLoader(ArrayDataset(tokens),
+                          batch_size=self.batch_size, shuffle=shuffle,
+                          drop_last=True)
+
+    def train_dataloader(self):
+        return self._loader(self.train_size, 0, shuffle=True)
+
+    def val_dataloader(self):
+        return self._loader(self.val_size, 1)
+
+
 def bert_partition_rules(tensor_axis: str = "tensor") -> list:
     """SpmdStrategy rules: Megatron column/row splits (gpt.py pattern)."""
     t = tensor_axis
@@ -123,7 +208,9 @@ def bert_partition_rules(tensor_axis: str = "tensor") -> list:
         ("proj/kernel", P(t, None)),
         ("fc/kernel", P(None, t)),
         ("out/kernel", P(t, None)),
-        (".*", P()),
+        ("mlm_head/kernel", P(None, t)),   # vocab-split MLM projection
+        # no catch-all: unmatched params fall through to SpmdStrategy's
+        # replicate-or-fsdp fallback (strategy.py _fsdp_fallback)
     ]
 
 

@@ -11,6 +11,12 @@ logging event, columns unioned across events.  ``Trainer(logger=True)``
 Rank-zero gating happens in the trainer (only rank 0's logger writes),
 so files on a shared FS are written once per run, like the reference's
 rank-zero-gated PL loggers.
+
+Distributed caveat for CUSTOM loggers: with actor plugins the trainer is
+pickled into the workers, so ``log_metrics`` fires on rank-0's *copy* —
+a logger must persist externally (file/DB/service, as CSVLogger does);
+in-memory state never returns to the driver (only ``callback_metrics``
+does, via the result relay).
 """
 
 from __future__ import annotations
@@ -42,6 +48,24 @@ class CSVLogger:
     def path(self) -> str:
         return os.path.join(self.log_dir, "metrics.csv")
 
+    def _sync_with_existing_file(self) -> None:
+        """Adopt an existing file's columns and switch to append mode.
+
+        State is derived from the FILE, not the instance: trainers are
+        pickled into workers per dispatch (plugins/xla.py), so a fresh
+        copy of this logger must continue the run's file, never truncate
+        it (e.g. fit then validate on the same trainer).
+        """
+        if self._started:
+            return
+        if os.path.exists(self.path):
+            with open(self.path, newline="") as f:
+                header = next(csv.reader(f), None)
+            if header:
+                self._fields.extend(
+                    k for k in header if k not in self._fields)
+                self._started = True
+
     def log_metrics(self, metrics: dict, step: int) -> None:
         row = {"step": int(step)}
         for k, v in metrics.items():
@@ -49,6 +73,7 @@ class CSVLogger:
                 row[k] = float(v)
             except (TypeError, ValueError):
                 continue
+        self._sync_with_existing_file()
         new_fields = [k for k in row if k not in self._fields]
         if new_fields:
             self._fields.extend(new_fields)

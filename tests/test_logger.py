@@ -81,3 +81,25 @@ def test_distributed_fit_rank_zero_writes(tmp_path, seed):
     path = os.path.join(str(tmp_path), "logs", "metrics.csv")
     assert os.path.exists(path)
     assert any(r.get("loss") for r in _read(path))
+
+
+def test_fit_then_validate_preserves_file(tmp_path, seed):
+    """A second dispatch (fresh pickled logger state) must append to the
+    run's metrics.csv, not truncate it."""
+    model = BoringModel()
+    trainer = Trainer(max_epochs=1, limit_train_batches=4,
+                      limit_val_batches=1, num_sanity_val_steps=0,
+                      enable_checkpointing=False, seed=0,
+                      log_every_n_steps=2,
+                      default_root_dir=str(tmp_path))
+    trainer.fit(model)
+    path = os.path.join(str(tmp_path), "logs", "metrics.csv")
+    rows_after_fit = len(_read(path))
+    assert rows_after_fit > 0
+    # simulate a fresh pickled copy continuing the same run dir
+    fresh = CSVLogger(str(tmp_path))
+    fresh.log_metrics({"extra_metric": 1.0}, step=99)
+    rows = _read(path)
+    assert len(rows) == rows_after_fit + 1      # appended, not truncated
+    assert rows[-1]["extra_metric"] == "1.0"
+    assert any(r.get("loss") for r in rows)     # old rows intact
