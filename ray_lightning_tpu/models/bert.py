@@ -185,11 +185,11 @@ class BertMLMModule(LightningModule):
 
     def _loader(self, n, seed, shuffle=False):
         from ray_lightning_tpu.models.gpt import synthetic_lm_dataset
+        # the steps unpack batch[0], so the (inputs, targets) dataset can
+        # pass through as-is — no need to copy the token matrix out
         ds = synthetic_lm_dataset(n, self.config.max_len,
                                   self.config.vocab_size - 1, seed)
-        tokens = ds.take(np.arange(len(ds)))[0]  # inputs only
-        return DataLoader(ArrayDataset(tokens),
-                          batch_size=self.batch_size, shuffle=shuffle,
+        return DataLoader(ds, batch_size=self.batch_size, shuffle=shuffle,
                           drop_last=True)
 
     def train_dataloader(self):

@@ -58,6 +58,14 @@ class GPTConfig:
     # long-seq × large-vocab configs (ops/losses.py); 0 = fused full-vocab
     # loss (faster when the logits fit, measured on v5e)
     chunked_ce: int = 0
+    # Mixture-of-Experts (ops/moe.py; beyond reference parity).  >0 swaps
+    # the MLP of every ``moe_every``-th block for a routed MoEMLP whose
+    # expert weights shard on the ``expert`` mesh axis.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_every: int = 2
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -79,6 +87,12 @@ CONFIGS = {
     # alone would be ~1.6GB/example-batch; the chunked loss streams them
     "gpt2-1p3b": GPTConfig(block_size=2048, n_layer=24, n_head=32,
                            n_embd=2048, chunked_ce=16),
+    # MoE variants (beyond parity): routed FFN every other block, expert
+    # weights sharded on the `expert` mesh axis (ops/moe.py)
+    "moe-tiny": GPTConfig(vocab_size=512, block_size=64, n_layer=2,
+                          n_head=2, n_embd=64, remat=False, n_experts=4),
+    "gpt2-moe-8e": GPTConfig(block_size=1024, n_layer=12, n_head=12,
+                             n_embd=768, n_experts=8),
 }
 
 
@@ -98,6 +112,7 @@ class MLP(nn.Module):
 
 class Block(nn.Module):
     config: GPTConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
@@ -107,8 +122,16 @@ class Block(nn.Module):
             dtype=cfg.dtype, attention_impl=cfg.attention_impl,
             name="attn")(
             nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x), deterministic)
-        x = x + MLP(cfg, name="mlp")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x), deterministic)
+        if self.use_moe:
+            from ray_lightning_tpu.ops.moe import MoEMLP
+            ffn = MoEMLP(n_experts=cfg.n_experts, d_ff=4 * cfg.n_embd,
+                         top_k=cfg.moe_top_k,
+                         capacity_factor=cfg.moe_capacity_factor,
+                         dtype=cfg.dtype, name="moe")
+        else:
+            ffn = MLP(cfg, name="mlp")
+        x = x + ffn(nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x),
+                    deterministic)
         return x
 
 
@@ -134,8 +157,11 @@ class GPT(nn.Module):
         if cfg.remat:
             # trade FLOPs for HBM: recompute block activations on backward
             block = nn.remat(Block, static_argnums=(2,))
-        self.blocks = [block(cfg, name=f"h{i}")
-                       for i in range(cfg.n_layer)]
+        self.blocks = [
+            block(cfg, use_moe=(cfg.n_experts > 0
+                                and i % cfg.moe_every == cfg.moe_every - 1),
+                  name=f"h{i}")
+            for i in range(cfg.n_layer)]
         self.ln_f = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")
 
     def hidden(self, idx, deterministic: bool = True):
@@ -167,7 +193,8 @@ def gpt_partition_rules(tensor_axis: str = "tensor") -> list[tuple[str, P]]:
     (riding ICI because tensor is the innermost mesh axis,
     parallel/mesh.py).
     """
-    return [
+    from ray_lightning_tpu.ops.moe import moe_partition_rules
+    return moe_partition_rules(tensor_axis=tensor_axis) + [
         (r"wte/embedding", P(tensor_axis, None)),
         (r"attn/qkv/kernel", P(None, tensor_axis)),
         (r"attn/proj/kernel", P(tensor_axis, None)),
@@ -241,6 +268,15 @@ class GPTLightningModule(LightningModule):
 
     def training_step(self, ctx, batch):
         loss = self._loss(ctx, batch)
+        if self.config.n_experts > 0:
+            # routed layers sowed their load-balance losses during the
+            # forward pass (mutable collections only flow back to the
+            # context under training, core/module.py ctx.apply)
+            from ray_lightning_tpu.ops.moe import total_aux_loss
+            aux = total_aux_loss(ctx.model_state)
+            if aux is not None:
+                ctx.log("moe_aux", aux)
+                loss = loss + self.config.moe_aux_weight * aux
         ctx.log("loss", loss)
         return loss
 

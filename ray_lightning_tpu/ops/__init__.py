@@ -7,5 +7,8 @@ full [T, T] score matrix would blow HBM).
 """
 
 from ray_lightning_tpu.ops.flash_attention import flash_attention
+from ray_lightning_tpu.ops.moe import (MoEMLP, moe_partition_rules,
+                                       total_aux_loss)
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "MoEMLP", "moe_partition_rules",
+           "total_aux_loss"]

@@ -1,0 +1,149 @@
+"""Mixture-of-Experts feed-forward layer with expert parallelism.
+
+Beyond the reference's parity surface (SURVEY.md §2.3 marks EP absent);
+built TPU-first rather than ported:
+
+- **Static shapes**: GShard/Switch-style fixed expert *capacity* — every
+  expert processes exactly ``capacity`` token slots per group, so the
+  whole layer is three einsums XLA can tile onto the MXU.  No dynamic
+  gather/scatter, no data-dependent shapes (SURVEY.md's XLA-semantics
+  constraint).
+- **Expert parallelism as sharding**: expert weights carry a leading
+  ``[n_experts, ...]`` dim annotated on the ``expert`` mesh axis
+  (``moe_partition_rules``); tokens stay sharded on ``data``.  GSPMD
+  lowers the dispatch/combine einsums to the all-to-all over ICI —
+  the same "parallelism is an annotation, collectives are compiler
+  output" inversion as the rest of ``parallel/strategy.py``.
+- **fp32 router**: gate logits/softmax in fp32 (bf16 routing is noisy
+  enough to destabilize small models), expert FFN in the compute dtype.
+
+The router sows its load-balance auxiliary loss into the ``losses``
+variable collection (overwrite semantics, so the carried value stays a
+scalar across steps); :func:`total_aux_loss` folds the collection into
+the training loss.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _overwrite(prev, new):
+    """sow reduce_fn: keep the latest value (no unbounded tuple growth
+    when the collection is threaded through successive train steps)."""
+    del prev
+    return new
+
+
+class MoEMLP(nn.Module):
+    """Drop-in MLP replacement routing each token to ``top_k`` experts.
+
+    Input/output: ``[groups, tokens, d_model]`` (groups = the batch dim;
+    capacity is computed per group).  Tokens beyond an expert's capacity
+    are *dropped* — their output is zero, and the surrounding residual
+    connection passes them through unchanged (the standard Switch
+    behavior).
+    """
+
+    n_experts: int
+    d_ff: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        del deterministic  # routing is deterministic; no dropout inside
+        G, S, M = x.shape
+        E, k = self.n_experts, self.top_k
+        if not 1 <= k <= E:
+            raise ValueError(f"top_k={k} must be in [1, {E}]")
+        capacity = min(S, int(math.ceil(self.capacity_factor * k * S / E)))
+
+        router = self.param("router", nn.initializers.normal(0.02), (M, E),
+                            jnp.float32)
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (E, M, self.d_ff), jnp.float32)
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (E, self.d_ff, M), jnp.float32)
+
+        gate_logits = jnp.einsum("gsm,me->gse", x.astype(jnp.float32), router)
+        probs = jax.nn.softmax(gate_logits, axis=-1)          # [G,S,E] fp32
+
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)         # [G,S,k]
+        if k > 1:
+            gate_vals = gate_vals / (
+                jnp.sum(gate_vals, axis=-1, keepdims=True) + 1e-9)
+        # k == 1 keeps the RAW top-1 probability (Switch-Transformer
+        # style): renormalizing would pin the combine weight at 1.0 and
+        # sever the router's gradient path through the task loss.
+
+        # Fill expert slots choice-by-choice; the per-expert position
+        # counter carries across choices so a token's 2nd-choice expert
+        # sees slots already taken by other tokens' 1st choices.
+        dispatch = jnp.zeros((G, S, E, capacity), dtype=x.dtype)
+        combine = jnp.zeros((G, S, E, capacity), dtype=jnp.float32)
+        taken = jnp.zeros((G, 1, E), dtype=jnp.int32)
+        for i in range(k):
+            onehot = jax.nn.one_hot(gate_idx[..., i], E,
+                                    dtype=jnp.int32)          # [G,S,E]
+            pos = jnp.cumsum(onehot, axis=1) - 1 + taken      # slot index
+            taken = taken + jnp.sum(onehot, axis=1, keepdims=True)
+            keep = onehot * (pos < capacity)                  # overflow drop
+            slot = jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity,
+                                  dtype=jnp.float32)          # [G,S,E,cap]
+            d_i = keep.astype(jnp.float32)[..., None] * slot
+            dispatch = dispatch + d_i.astype(x.dtype)
+            combine = combine + gate_vals[..., i, None, None] * d_i
+
+        # Switch load-balance loss: E * sum_e(frac_tokens_e * mean_prob_e);
+        # 1.0 at perfect balance, grows as routing collapses.
+        first = jax.nn.one_hot(gate_idx[..., 0], E, dtype=jnp.float32)
+        frac = jnp.mean(first, axis=(0, 1))
+        mean_prob = jnp.mean(probs, axis=(0, 1))
+        aux = E * jnp.sum(frac * mean_prob)
+        self.sow("losses", "moe_aux", aux, reduce_fn=_overwrite,
+                 init_fn=lambda: jnp.zeros((), jnp.float32))
+
+        # dispatch → expert FFN → combine: three MXU einsums.  With w1/w2
+        # sharded on the expert axis and tokens on data, GSPMD inserts the
+        # token all-to-all around the FFN automatically.
+        xe = jnp.einsum("gsec,gsm->egcm", dispatch, x)
+        h = jnp.einsum("egcm,emh->egch", xe, w1.astype(self.dtype))
+        h = nn.gelu(h)
+        out = jnp.einsum("egch,ehm->egcm", h, w2.astype(self.dtype))
+        return jnp.einsum("gsec,egcm->gsm", combine.astype(self.dtype), out)
+
+
+def moe_partition_rules(expert_axis: str = "expert",
+                        tensor_axis: str = "tensor"):
+    """SpmdStrategy rules for MoE parameters (prepend to the model's own
+    rules).  Expert dim sharded on ``expert``; within each expert the FFN
+    is Megatron-split on ``tensor``; the router stays replicated (it is
+    tiny and every data shard needs it)."""
+    from jax.sharding import PartitionSpec as P
+    return [
+        (r"moe/w1$", P(expert_axis, None, tensor_axis)),
+        (r"moe/w2$", P(expert_axis, tensor_axis, None)),
+        (r"moe/router$", P()),
+    ]
+
+
+def total_aux_loss(model_state) -> "jax.Array | None":
+    """Sum every sown ``losses`` leaf (one per MoE layer), or None if the
+    model has no loss-sowing layers."""
+    tree = (model_state or {}).get("losses")
+    if not tree:
+        return None
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return None
+    total = leaves[0]
+    for leaf in leaves[1:]:
+        total = total + leaf
+    return total

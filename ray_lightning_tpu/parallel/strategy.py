@@ -233,7 +233,8 @@ class SpmdStrategy(ShardingStrategy):
     def __init__(
         self,
         rules: Sequence[tuple[str, P]] = (),
-        axis_names: Sequence[str] = ("data", "fsdp", "sequence", "tensor"),
+        axis_names: Sequence[str] = ("data", "fsdp", "expert", "sequence",
+                                     "tensor"),
         axis_sizes: dict[str, int] | None = None,
         shard_sequence_dim: bool = True,
         min_shard_elements: int = 0,

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import csv
 import os
+import uuid
 
 
 class CSVLogger:
@@ -39,6 +40,12 @@ class CSVLogger:
         self.name = name
         self._fields: list[str] = ["step"]
         self._started = False
+        # Identifies THIS logical run across pickled copies (the trainer
+        # is re-pickled into workers per dispatch, so fit→validate uses
+        # two copies of this object that must share one file) while
+        # distinguishing a genuinely new run pointed at the same root
+        # dir, which must truncate rather than append to the stale file.
+        self._run_id = uuid.uuid4().hex
 
     @property
     def log_dir(self) -> str:
@@ -48,17 +55,29 @@ class CSVLogger:
     def path(self) -> str:
         return os.path.join(self.log_dir, "metrics.csv")
 
-    def _sync_with_existing_file(self) -> None:
-        """Adopt an existing file's columns and switch to append mode.
+    @property
+    def _runid_path(self) -> str:
+        return self.path + ".runid"
 
-        State is derived from the FILE, not the instance: trainers are
-        pickled into workers per dispatch (plugins/xla.py), so a fresh
-        copy of this logger must continue the run's file, never truncate
-        it (e.g. fit then validate on the same trainer).
+    def _sync_with_existing_file(self) -> None:
+        """Adopt an existing file's columns and switch to append mode —
+        but only when the file belongs to this run (runid sidecar
+        matches).  A matching file means this logger is a pickled copy of
+        the run's original (plugins/xla.py re-pickles the trainer per
+        dispatch, e.g. fit then validate) and must append; a mismatched
+        or missing sidecar means the file is a leftover from a previous
+        run sharing the root dir and must be truncated, not extended.
         """
         if self._started:
             return
         if os.path.exists(self.path):
+            try:
+                with open(self._runid_path) as f:
+                    owner = f.read().strip()
+            except OSError:
+                owner = None
+            if owner != self._run_id:
+                return  # stale file from another run: overwrite on write
             with open(self.path, newline="") as f:
                 header = next(csv.reader(f), None)
             if header:
@@ -88,6 +107,9 @@ class CSVLogger:
             if mode == "w":
                 writer.writeheader()
             writer.writerow(row)
+        if mode == "w":
+            with open(self._runid_path, "w") as f:
+                f.write(self._run_id)
         self._started = True
 
     def _rewrite_with_new_header(self) -> None:

@@ -4,6 +4,7 @@ logger=False, custom loggers pluggable."""
 
 import csv
 import os
+import pickle
 
 from ray_lightning_tpu import Trainer
 from ray_lightning_tpu.models import BoringModel
@@ -96,10 +97,31 @@ def test_fit_then_validate_preserves_file(tmp_path, seed):
     path = os.path.join(str(tmp_path), "logs", "metrics.csv")
     rows_after_fit = len(_read(path))
     assert rows_after_fit > 0
-    # simulate a fresh pickled copy continuing the same run dir
-    fresh = CSVLogger(str(tmp_path))
+    # a pickled copy of the run's logger (what a second dispatch actually
+    # ships, plugins/xla.py) continues the same file: fresh _started
+    # state, same _run_id
+    fresh = pickle.loads(pickle.dumps(trainer.logger))
+    fresh._started = False
+    fresh._fields = ["step"]
     fresh.log_metrics({"extra_metric": 1.0}, step=99)
     rows = _read(path)
     assert len(rows) == rows_after_fit + 1      # appended, not truncated
     assert rows[-1]["extra_metric"] == "1.0"
     assert any(r.get("loss") for r in rows)     # old rows intact
+
+
+def test_new_run_truncates_stale_file(tmp_path):
+    """A brand-new logger pointed at a dir holding another run's
+    metrics.csv starts fresh instead of appending to the stale file."""
+    old = CSVLogger(str(tmp_path))
+    old.log_metrics({"loss": 1.0}, step=0)
+    old.log_metrics({"loss": 0.5}, step=1)
+    path = os.path.join(str(tmp_path), "logs", "metrics.csv")
+    assert len(_read(path)) == 2
+
+    new = CSVLogger(str(tmp_path))            # different run id
+    new.log_metrics({"acc": 0.9}, step=0)
+    rows = _read(path)
+    assert len(rows) == 1                     # truncated, not appended
+    assert rows[0]["acc"] == "0.9"
+    assert "loss" not in rows[0]
