@@ -36,6 +36,7 @@ from ray_lightning_tpu.core.callbacks import (
     Callback,
     EarlyStopping,
     ModelCheckpoint,
+    ShardedCheckpoint,
 )
 from ray_lightning_tpu.utils.seed import seed_everything
 from ray_lightning_tpu.utils.logger import CSVLogger
@@ -60,6 +61,7 @@ __all__ = [
     "Callback",
     "EarlyStopping",
     "ModelCheckpoint",
+    "ShardedCheckpoint",
     "seed_everything",
     "CSVLogger",
     "ThroughputMonitor",

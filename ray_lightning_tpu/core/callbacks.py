@@ -175,6 +175,49 @@ class ModelCheckpoint(Callback):
         self._saved = [tuple(t) for t in state.get("saved", [])]
 
 
+class ShardedCheckpoint(Callback):
+    """Periodic sharded (orbax) checkpointing — the pod-scale complement
+    to :class:`ModelCheckpoint`.
+
+    Saves the live TrainState shard-by-shard and asynchronously
+    (utils/checkpoint.py): every process writes only what it owns, the
+    disk write overlaps subsequent training steps, and nothing is
+    gathered to one host.  Resume by pointing
+    ``Trainer(resume_from_checkpoint=...)`` at the directory.
+    """
+
+    def __init__(self, dirpath: Optional[str] = None,
+                 every_n_train_steps: int = 0, every_n_epochs: int = 1,
+                 max_to_keep: Optional[int] = None):
+        self.dirpath = dirpath
+        self.every_n_train_steps = every_n_train_steps
+        self.every_n_epochs = every_n_epochs
+        self.max_to_keep = max_to_keep
+
+    def setup(self, trainer, module, stage: str) -> None:
+        if self.dirpath is None:
+            self.dirpath = os.path.join(trainer.default_root_dir,
+                                        "sharded_checkpoints")
+
+    def _save(self, trainer) -> None:
+        trainer.save_sharded_checkpoint(self.dirpath,
+                                        max_to_keep=self.max_to_keep)
+
+    def on_train_batch_end(self, trainer, module, outputs, batch,
+                           batch_idx) -> None:
+        n = self.every_n_train_steps
+        if n and trainer.global_step and trainer.global_step % n == 0:
+            self._save(trainer)
+
+    def on_train_epoch_end(self, trainer, module) -> None:
+        n = self.every_n_epochs
+        if n and (trainer.current_epoch + 1) % n == 0:
+            self._save(trainer)
+
+    def on_train_end(self, trainer, module) -> None:
+        trainer.wait_for_checkpoints()
+
+
 class EarlyStopping(Callback):
     """Stop training when a monitored metric stops improving
     (exercised by the reference at tests/test_ddp.py:287-306)."""

@@ -142,6 +142,7 @@ class Trainer:
         self._epoch_metric_acc: dict[str, list] = {}
         self._warned_skip = False
         self._stage = None
+        self._sharded_checkpointers: dict = {}
 
     # ------------------------------------------------------------------
     # pickling across the driver→worker boundary (ray_ddp.py:164-172
@@ -154,6 +155,7 @@ class Trainer:
             state[f] = None
         state["lightning_module"] = None
         state["datamodule"] = None
+        state["_sharded_checkpointers"] = {}  # live orbax managers
         return state
 
     # ------------------------------------------------------------------
@@ -438,8 +440,12 @@ class Trainer:
 
         start_epoch = self.current_epoch
         epoch = start_epoch
+        ran_epoch = False
         try:
             for epoch in range(start_epoch, self.max_epochs or 10**9):
+                if self.should_stop or self._max_steps_reached():
+                    break  # e.g. resumed from a checkpoint at max_steps
+                ran_epoch = True
                 self.current_epoch = epoch
                 if hasattr(train_loader, "set_epoch"):
                     train_loader.set_epoch(epoch)
@@ -462,8 +468,12 @@ class Trainer:
                 if self.should_stop or self._max_steps_reached():
                     break
         finally:
-            self.current_epoch = min(epoch + 1, self.max_epochs or epoch + 1) \
-                if not self.should_stop else epoch
+            if ran_epoch:
+                self.current_epoch = min(
+                    epoch + 1, self.max_epochs or epoch + 1) \
+                    if not self.should_stop else epoch
+            # else: zero epochs ran (resumed at max_steps) — the restored
+            # epoch counter must not drift upward per save/resume cycle
             module.on_train_end()
             for cb in self.callbacks:
                 cb.on_train_end(self, module)
@@ -478,6 +488,8 @@ class Trainer:
 
     def _train_epoch(self, module, train_loader, val_loader, strategy):
         for batch_idx, batch in enumerate(train_loader):
+            if self.should_stop or self._max_steps_reached():
+                break
             if self.limit_train_batches is not None \
                     and batch_idx >= self.limit_train_batches:
                 break
@@ -684,6 +696,7 @@ class Trainer:
     # -- finalization / results round-trip -------------------------------
 
     def _finalize_fit(self, module):
+        self._close_sharded_checkpointers()
         self._flush_epoch_metrics()
         trained = {"params": fetch_tree(self.state.params),
                    "model_state": fetch_tree(self.state.model_state)}
@@ -741,12 +754,59 @@ class Trainer:
                     f.write(payload)
                 os.replace(tmp, filepath)
 
+    def save_sharded_checkpoint(self, directory: str,
+                                step: Optional[int] = None,
+                                max_to_keep: Optional[int] = None) -> None:
+        """Sharded (orbax) save: every process writes only its own array
+        shards, asynchronously — no host gather, unlike
+        :meth:`save_checkpoint` (utils/checkpoint.py rationale).  All
+        processes must call this (collective)."""
+        from ray_lightning_tpu.utils.checkpoint import ShardedCheckpointer
+        ckpt = self._sharded_checkpointers.get(directory)
+        if ckpt is None:
+            ckpt = ShardedCheckpointer(directory, max_to_keep=max_to_keep)
+            self._sharded_checkpointers[directory] = ckpt
+        module = self.lightning_module
+        meta = {
+            "epoch": int(self.current_epoch),
+            "global_step": int(self.global_step),
+            "world_size": int(self.world_size),
+            "strategy": self.plugin.strategy.name
+            if self.plugin.strategy else "none",
+            "hparams": _sanitize(dict(module.hparams)) if module else {},
+            "callbacks": {type(cb).__name__: _sanitize(cb.state_dict())
+                          for cb in self.callbacks},
+        }
+        ckpt.save(step if step is not None else int(self.global_step),
+                  self.state, meta)
+
+    def wait_for_checkpoints(self) -> None:
+        """Block until in-flight async sharded saves are durable."""
+        for ckpt in self._sharded_checkpointers.values():
+            ckpt.wait()
+
+    def _close_sharded_checkpointers(self) -> None:
+        """Wait + release orbax managers (their async worker threads
+        outlive the fit otherwise).  A later save simply re-opens."""
+        for ckpt in self._sharded_checkpointers.values():
+            try:
+                ckpt.wait()
+                ckpt.close()
+            except Exception:  # closing must never mask fit results
+                _log.warning("sharded checkpointer close failed",
+                             exc_info=True)
+        self._sharded_checkpointers = {}
+
     @staticmethod
     def load_checkpoint_dict(filepath: str) -> dict:
         with fsspec.open(filepath, "rb") as f:
             return serialization.msgpack_restore(f.read())
 
     def _restore_checkpoint(self, filepath: str, module) -> None:
+        from ray_lightning_tpu.utils.checkpoint import ShardedCheckpointer
+        if ShardedCheckpointer.is_sharded_checkpoint(filepath):
+            self._restore_sharded(filepath, module)
+            return
         ckpt = self.load_checkpoint_dict(filepath)
         # Re-shard on load: checkpoints always hold the full (gathered)
         # state, so resuming with a different world size / strategy just
@@ -766,6 +826,33 @@ class Trainer:
             module.on_load_checkpoint(ckpt)
         for cb in self.callbacks:
             cb.on_load_checkpoint(self, module, ckpt)
+
+    def _restore_sharded(self, directory: str, module) -> None:
+        """Restore from an orbax directory (root → latest step; a
+        specific step dir works too), re-sharding straight into the
+        CURRENT mesh — the full state never materializes on one host
+        (utils/checkpoint.py)."""
+        from ray_lightning_tpu.utils.checkpoint import (ShardedCheckpointer,
+                                                        abstract_like)
+        root, step = ShardedCheckpointer.split_step_dir(directory)
+        ckpt = ShardedCheckpointer(root)
+        try:
+            state, meta = ckpt.restore(
+                abstract_like(self.state, self._state_shardings), step=step)
+        finally:
+            ckpt.close()
+        self.state = state
+        self.current_epoch = int(meta.get("epoch", 0))
+        self.global_step = int(meta.get("global_step", 0))
+        cb_states = meta.get("callbacks", {})
+        for cb in self.callbacks:
+            st = cb_states.get(type(cb).__name__)
+            if st:
+                cb.load_state_dict(st)
+        if module is not None:
+            module.on_load_checkpoint(meta)
+        for cb in self.callbacks:
+            cb.on_load_checkpoint(self, module, meta)
 
     # elapsed-time helper used by examples/benchmarks
     @staticmethod
