@@ -4,6 +4,7 @@ from ray_lightning_tpu.models.boring import (
     RandomDataset,
 )
 from ray_lightning_tpu.models.gpt import GPT, GPTConfig, GPTLightningModule
+from ray_lightning_tpu.models.pipeline_gpt import PipelinedGPT
 from ray_lightning_tpu.models.resnet import (
     ResNet,
     ResNetConfig,
@@ -25,6 +26,7 @@ __all__ = [
     "GPT",
     "GPTConfig",
     "GPTLightningModule",
+    "PipelinedGPT",
     "ResNet",
     "ResNetConfig",
     "ResNetLightningModule",

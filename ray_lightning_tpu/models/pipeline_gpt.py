@@ -1,0 +1,154 @@
+"""GPT with pipeline-parallel blocks (parallel/pipeline.py).
+
+Same transformer math as :mod:`models.gpt` — it literally reuses that
+module's flax ``Block`` — but the blocks' parameters are *stacked* with
+a leading layer dim so they can shard over the ``stage`` mesh axis and
+run under the GPipe schedule.  This module manages raw parameters
+through ``init_params`` / pure functions (the framework's
+``configure_model() -> None`` escape hatch, core/module.py): flax's
+module system wants one object per layer, while pipelining wants one
+parameter tree scanned over — stacking at init is the TPU-native shape.
+
+Beyond reference parity (SURVEY.md §2.3: PP absent there).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_lightning_tpu.core.data import DataLoader
+from ray_lightning_tpu.core.module import LightningModule
+from ray_lightning_tpu.models.gpt import (CONFIGS, Block, GPTConfig,
+                                          synthetic_lm_dataset)
+from ray_lightning_tpu.parallel.pipeline import pipeline_forward
+
+
+def _layernorm(x, scale, bias, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * scale + bias).astype(
+        x.dtype)
+
+
+class PipelinedGPT(LightningModule):
+    """Decoder LM whose blocks run under the GPipe schedule.
+
+    ``n_microbatches`` divides the per-data-shard batch; bubble overhead
+    shrinks as it grows ((S-1)/(M+S-1)).  On a mesh without a ``stage``
+    axis the same code is a plain sequential scan — one model,
+    any mesh.
+    """
+
+    def __init__(self, config: "GPTConfig | str" = "tiny",
+                 n_microbatches: int = 2, lr: float = 3e-4,
+                 weight_decay: float = 0.01, dataset_size: int = 256,
+                 batch_size: int = 8):
+        super().__init__()
+        if isinstance(config, str):
+            config = CONFIGS[config]
+        if config.dropout > 0:
+            # dropout needs a per-layer RNG stream threaded through the
+            # GPipe scan; silently training without it would diverge from
+            # the equivalent GPT run, so fail loudly instead
+            raise ValueError(
+                "PipelinedGPT does not support dropout yet; set "
+                "GPTConfig(dropout=0.0)")
+        if config.attention_impl in ("auto", "ring"):
+            # the pipeline body is already a manual (shard_map) region:
+            # mesh-consulting impls would open a nested shard_map there
+            # (trace error on multi-chip).  "local" = per-device flash on
+            # TPU / dot elsewhere — the right choice inside the schedule.
+            config = dataclasses.replace(config, attention_impl="local")
+        self.config = config
+        self.n_microbatches = n_microbatches
+        self.save_hyperparameters("lr", "weight_decay", "batch_size")
+        self.lr = lr
+        self.weight_decay = weight_decay
+        self.dataset_size = dataset_size
+        self.batch_size = batch_size
+        self._block = Block(config)
+
+    # -- params ----------------------------------------------------------
+
+    def init_params(self, rng, batch):
+        cfg = self.config
+        x = batch[0] if isinstance(batch, (tuple, list)) else batch
+        k_emb, k_pos, k_blocks = jax.random.split(rng, 3)
+        h0 = jnp.zeros((1, x.shape[1], cfg.n_embd), cfg.dtype)
+        block_keys = jax.random.split(k_blocks, cfg.n_layer)
+        # stacked block params: every leaf gains a leading n_layer dim —
+        # the axis PipelineStrategy shards on `stage`
+        blocks = jax.vmap(
+            lambda k: self._block.init(k, h0, True)["params"])(block_keys)
+        params = {
+            "wte": jax.random.normal(k_emb, (cfg.vocab_size, cfg.n_embd),
+                                     jnp.float32) * 0.02,
+            "wpe": jax.random.normal(k_pos, (cfg.block_size, cfg.n_embd),
+                                     jnp.float32) * 0.02,
+            "blocks": blocks,
+            "ln_f": {"scale": jnp.ones((cfg.n_embd,), jnp.float32),
+                     "bias": jnp.zeros((cfg.n_embd,), jnp.float32)},
+        }
+        return {"params": params}
+
+    def configure_optimizers(self):
+        return optax.adamw(self.lr, weight_decay=self.weight_decay,
+                           b1=0.9, b2=0.95)
+
+    # -- compute ---------------------------------------------------------
+
+    def _forward(self, params, idx):
+        cfg = self.config
+        T = idx.shape[1]
+        h = (params["wte"][idx]
+             + params["wpe"][:T]).astype(cfg.dtype)
+
+        def stage_fn(layer_params, x):
+            return self._block.apply({"params": layer_params}, x, True)
+
+        if cfg.remat:
+            # same HBM-for-FLOPs trade GPT applies via nn.remat
+            # (gpt.py Block wrapping): recompute each layer on backward
+            stage_fn = jax.checkpoint(stage_fn)
+        h = pipeline_forward(stage_fn, params["blocks"], h,
+                             n_microbatches=self.n_microbatches)
+        h = _layernorm(h, params["ln_f"]["scale"], params["ln_f"]["bias"])
+        return jnp.einsum("btc,vc->btv", h,
+                          params["wte"].astype(cfg.dtype)
+                          ).astype(jnp.float32)
+
+    def _loss(self, ctx, batch):
+        x, y = batch
+        logits = self._forward(ctx.params, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    def training_step(self, ctx, batch):
+        loss = self._loss(ctx, batch)
+        ctx.log("loss", loss)
+        return loss
+
+    def validation_step(self, ctx, batch):
+        ctx.log("val_loss", self._loss(ctx, batch))
+
+    def predict_step(self, ctx, batch):
+        x = batch[0] if isinstance(batch, (tuple, list)) else batch
+        return jnp.argmax(self._forward(ctx.params, x), axis=-1)
+
+    # -- data ------------------------------------------------------------
+
+    def _loader(self, seed):
+        ds = synthetic_lm_dataset(self.dataset_size, self.config.block_size,
+                                  self.config.vocab_size, seed)
+        return DataLoader(ds, batch_size=self.batch_size, drop_last=True)
+
+    def train_dataloader(self):
+        return self._loader(0)
+
+    def val_dataloader(self):
+        return self._loader(1)

@@ -100,11 +100,25 @@ def sharded_flash_attention(q, k, v, *, mesh, causal: bool = True,
     return fn(q, k, v)
 
 
+def local_attention(q, k, v, **kw):
+    """Per-device attention for MANUAL (shard_map) regions — e.g. inside
+    the pipeline schedule (parallel/pipeline.py), where the mesh axes
+    are already manual and opening another shard_map (as auto_attention's
+    sharded path would) is a trace error.  Picks the pallas flash kernel
+    on TPU, the XLA dot path elsewhere; never consults the mesh."""
+    if jax.devices()[0].platform == "tpu":
+        from ray_lightning_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, **kw)
+    return dot_product_attention(q, k, v, **kw)
+
+
 def resolve_attention(impl: str) -> Callable:
     if impl == "auto":
         return auto_attention
     if impl == "dot":
         return dot_product_attention
+    if impl == "local":
+        return local_attention
     if impl == "flash":
         from ray_lightning_tpu.ops.flash_attention import flash_attention
         return flash_attention

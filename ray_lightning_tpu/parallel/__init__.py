@@ -1,4 +1,8 @@
 from ray_lightning_tpu.parallel.mesh import build_device_mesh
+from ray_lightning_tpu.parallel.pipeline import (
+    PipelineStrategy,
+    pipeline_forward,
+)
 from ray_lightning_tpu.parallel.strategy import (
     DataParallelStrategy,
     FullyShardedStrategy,
@@ -15,5 +19,7 @@ __all__ = [
     "Zero1Strategy",
     "FullyShardedStrategy",
     "SpmdStrategy",
+    "PipelineStrategy",
+    "pipeline_forward",
     "resolve_strategy",
 ]
