@@ -52,7 +52,9 @@ class GPTConfig:
     dropout: float = 0.0
     remat: bool = True
     dtype: Any = jnp.bfloat16        # compute dtype; params stay fp32
-    attention_impl: str = "auto"     # "auto" | "dot" | "flash" | "ring"
+    # "auto" | "dot" | "flash" | "ring" | "local" (ops/attention.py;
+    # "local" = per-device flash/dot for manual shard_map regions)
+    attention_impl: str = "auto"
     # >0: compute the LM loss with chunked_softmax_cross_entropy over this
     # many row chunks instead of full fp32 logits — the memory opt-in for
     # long-seq × large-vocab configs (ops/losses.py); 0 = fused full-vocab

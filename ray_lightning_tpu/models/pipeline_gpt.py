@@ -51,6 +51,14 @@ class PipelinedGPT(LightningModule):
         super().__init__()
         if isinstance(config, str):
             config = CONFIGS[config]
+        if config.n_experts > 0:
+            # GPT enables MoEMLP per layer (gpt.py Block use_moe); here
+            # every block is dense, and the expert all-to-all would also
+            # nest a shard_map inside the pipeline's manual region —
+            # reject rather than silently train a different model
+            raise ValueError(
+                "PipelinedGPT does not support MoE configs yet; set "
+                "GPTConfig(n_experts=0)")
         if config.dropout > 0:
             # dropout needs a per-layer RNG stream threaded through the
             # GPipe scan; silently training without it would diverge from

@@ -114,6 +114,10 @@ def pipeline_forward(stage_fn: Callable[[Any, jax.Array], jax.Array],
     data_size = 1
     for a in (dp or ()):
         data_size *= mesh.shape[a]
+    if x.shape[0] % max(1, data_size):
+        raise ValueError(
+            f"global batch {x.shape[0]} does not divide across "
+            f"{data_size} data shards")
     b_loc, rem = divmod(x.shape[0] // max(1, data_size), n_microbatches)
     if rem or b_loc == 0:
         raise ValueError(
