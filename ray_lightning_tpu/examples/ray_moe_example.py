@@ -1,0 +1,89 @@
+"""Mixture-of-Experts GPT over a (data × expert × tensor) mesh.
+
+Beyond the reference's capability surface (SURVEY.md §2.3 marks expert
+parallelism absent): the routed FFN (ops/moe.py) keeps every shape
+static (GShard-style fixed expert capacity), expert weights shard their
+leading dim on the ``expert`` mesh axis, and GSPMD lowers the
+dispatch/combine einsums to the token all-to-all over ICI.  The router's
+load-balance loss folds into the training loss automatically
+(GPTLightningModule.training_step) and surfaces as the ``moe_aux``
+metric.
+
+Run locally without a TPU via virtual CPU devices:
+    python -m ray_lightning_tpu.examples.ray_moe_example --smoke-test
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def train(expert: int = 2,
+          tensor: int = 2,
+          model_size: str = "gpt2-moe-8e",
+          num_epochs: int = 1,
+          batch_size: int = 8,
+          dataset_size: int = 64,
+          precision: str = "bf16",
+          limit_train_batches: int | None = None):
+    from ray_lightning_tpu import Trainer
+    from ray_lightning_tpu.models.gpt import (
+        CONFIGS, GPTLightningModule, gpt_partition_rules)
+    from ray_lightning_tpu.parallel.strategy import SpmdStrategy
+
+    cfg = CONFIGS[model_size]
+    module = GPTLightningModule(cfg, dataset_size=dataset_size,
+                                batch_size=batch_size)
+    strategy = SpmdStrategy(
+        rules=gpt_partition_rules(),
+        axis_names=("data", "expert", "tensor"),
+        axis_sizes={"expert": expert, "tensor": tensor},
+    )
+    trainer = Trainer(
+        max_epochs=num_epochs,
+        strategy=strategy,
+        precision=precision,
+        limit_train_batches=limit_train_batches,
+        limit_val_batches=0,
+        num_sanity_val_steps=0,
+        enable_checkpointing=False,
+        log_every_n_steps=1,
+    )
+    trainer.fit(module)
+    return trainer
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--expert", type=int, default=2,
+                        help="Expert-parallel axis size.")
+    parser.add_argument("--tensor", type=int, default=2,
+                        help="Tensor-parallel axis size within experts.")
+    parser.add_argument("--model-size", type=str, default="gpt2-moe-8e")
+    parser.add_argument("--num-epochs", type=int, default=1)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--smoke-test", action="store_true", default=False)
+    args = parser.parse_args()
+
+    kwargs: dict = dict(expert=args.expert, tensor=args.tensor,
+                        model_size=args.model_size,
+                        num_epochs=args.num_epochs,
+                        batch_size=args.batch_size)
+    if args.smoke_test:
+        from ray_lightning_tpu.utils.platform import host_device_count_flags
+        os.environ["XLA_FLAGS"] = host_device_count_flags(
+            2 * args.expert * args.tensor)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        kwargs.update(model_size="moe-tiny", batch_size=4, dataset_size=8,
+                      limit_train_batches=2, precision="32")
+
+    trainer = train(**kwargs)
+    metrics = dict(trainer.callback_metrics)
+    print("Final metrics:", metrics)
+    assert "moe_aux" in metrics, "router aux loss did not surface"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Pipeline-parallel GPT: GPipe microbatching over a (data × stage) mesh.
+
+Beyond the reference's capability surface (SURVEY.md §2.3 marks pipeline
+parallelism absent): the blocks' parameters are layer-stacked and
+sharded over the ``stage`` axis, activations hop between stages with
+``lax.ppermute``, and the whole schedule is one compiled SPMD program
+(parallel/pipeline.py).  Raise ``--microbatches`` to shrink the pipeline
+bubble ((S-1)/(M+S-1)).
+
+Run locally without a TPU via virtual CPU devices:
+    python -m ray_lightning_tpu.examples.ray_pipeline_example --smoke-test
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def train(stages: int = 4,
+          microbatches: int = 4,
+          model_size: str = "gpt2-small",
+          num_epochs: int = 1,
+          batch_size: int = 8,
+          dataset_size: int = 64,
+          precision: str = "bf16",
+          limit_train_batches: int | None = None):
+    from ray_lightning_tpu import Trainer
+    from ray_lightning_tpu.models.pipeline_gpt import PipelinedGPT
+    from ray_lightning_tpu.parallel.pipeline import PipelineStrategy
+
+    module = PipelinedGPT(model_size, n_microbatches=microbatches,
+                          dataset_size=dataset_size,
+                          batch_size=batch_size)
+    trainer = Trainer(
+        max_epochs=num_epochs,
+        strategy=PipelineStrategy(stages=stages),
+        precision=precision,
+        limit_train_batches=limit_train_batches,
+        limit_val_batches=0,
+        num_sanity_val_steps=0,
+        enable_checkpointing=False,
+        log_every_n_steps=1,
+    )
+    trainer.fit(module)
+    return trainer
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--stages", type=int, default=4,
+                        help="Pipeline stages (must divide n_layer).")
+    parser.add_argument("--microbatches", type=int, default=4)
+    parser.add_argument("--model-size", type=str, default="gpt2-small")
+    parser.add_argument("--num-epochs", type=int, default=1)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--smoke-test", action="store_true", default=False)
+    args = parser.parse_args()
+
+    kwargs: dict = dict(stages=args.stages,
+                        microbatches=args.microbatches,
+                        model_size=args.model_size,
+                        num_epochs=args.num_epochs,
+                        batch_size=args.batch_size)
+    if args.smoke_test:
+        from ray_lightning_tpu.utils.platform import host_device_count_flags
+        os.environ["XLA_FLAGS"] = host_device_count_flags(4)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        kwargs.update(model_size="tiny", stages=2, microbatches=2,
+                      batch_size=4, dataset_size=8, limit_train_batches=2,
+                      precision="32")
+
+    trainer = train(**kwargs)
+    print("Final metrics:", dict(trainer.callback_metrics))
+
+
+if __name__ == "__main__":
+    main()

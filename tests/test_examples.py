@@ -15,6 +15,8 @@ EXAMPLES = [
     "ray_lightning_tpu.examples.ray_ddp_sharded_example",
     "ray_lightning_tpu.examples.ray_spmd_example",
     "ray_lightning_tpu.examples.ray_longcontext_example",
+    "ray_lightning_tpu.examples.ray_moe_example",
+    "ray_lightning_tpu.examples.ray_pipeline_example",
 ]
 
 
