@@ -1,0 +1,36 @@
+"""Opt-in real-hardware test, never run in CI.
+
+Reference parity: test_ddp_gpu.py:125-136 gates a real-cluster run
+behind ``CLUSTER=1`` (``ray.init("auto")``, workers sized to all
+cluster GPUs).  Here ``CLUSTER=1`` runs one TPU-backed fit sized to the
+attached chips — on a pod this exercises real ICI collectives; CI and
+default local runs skip.
+
+    CLUSTER=1 python -m pytest tests/test_cluster_optin.py -q
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("CLUSTER") != "1",
+    reason="opt-in real-hardware test; set CLUSTER=1 to run")
+
+
+def test_tpu_fit_on_attached_chips():
+    import jax
+
+    from ray_lightning_tpu import Trainer
+    from ray_lightning_tpu.models.gpt import GPTLightningModule
+
+    n = jax.device_count()
+    module = GPTLightningModule("tiny", dataset_size=8 * n, batch_size=2 * n)
+    trainer = Trainer(max_epochs=1, limit_val_batches=0,
+                      num_sanity_val_steps=0, enable_checkpointing=False,
+                      log_every_n_steps=1, seed=0,
+                      strategy="ddp" if n > 1 else None)
+    trainer.fit(module)
+    assert trainer.global_step == 4
+    assert np.isfinite(float(trainer.callback_metrics["loss"]))

@@ -1,0 +1,53 @@
+"""Failure surfacing: a DEAD worker (hard process exit, no exception)
+must fail the fit with a real error on the driver, never hang.
+
+Reference behavior (SURVEY.md §5): no elastic recovery — a worker crash
+surfaces as a raised ``ray.get`` error in ``process_results``
+(util.py:61-63) and fails the whole fit.  The raising-worker variant is
+covered in test_plugin_distributed.py::test_worker_failure_raises_on_driver;
+this file covers the harsher kill-without-cleanup mode and driver
+reusability afterwards.
+"""
+
+import os
+
+import pytest
+
+from ray_lightning_tpu import Callback, Trainer
+from ray_lightning_tpu.models import BoringModel
+
+from tests.utils import cpu_plugin
+
+
+def _trainer(cb):
+    return Trainer(max_epochs=1, limit_train_batches=4, limit_val_batches=0,
+                   num_sanity_val_steps=0, enable_checkpointing=False,
+                   callbacks=[cb], plugins=[cpu_plugin(2)], seed=0,
+                   log_every_n_steps=1)
+
+
+def test_worker_hard_crash_raises_not_hangs():
+    class DieInWorker(Callback):
+        """Hard-kills the worker (no exception, no teardown)."""
+
+        def on_train_batch_end(self, trainer, module, outputs, batch, idx):
+            os._exit(17)
+
+    with pytest.raises(Exception):
+        _trainer(DieInWorker()).fit(BoringModel())
+
+
+def test_driver_usable_after_worker_failure():
+    """After a failed distributed fit, the driver process can run a fresh
+    (local) fit — no leaked global state."""
+
+    class Boom(Callback):
+        def on_train_start(self, trainer, module):
+            raise RuntimeError("boom for reuse test")
+
+    with pytest.raises(Exception, match="boom for reuse test"):
+        _trainer(Boom()).fit(BoringModel())
+    t = Trainer(max_epochs=1, limit_train_batches=2, limit_val_batches=0,
+                num_sanity_val_steps=0, enable_checkpointing=False, seed=0)
+    t.fit(BoringModel())
+    assert t.global_step == 2
