@@ -7,14 +7,14 @@ Prints exactly ONE JSON line:
 
 The reference publishes no numbers (BASELINE.md); ``vs_baseline`` is
 measured against the stored first-round value below so rounds are
-comparable to each other.
+comparable to each other.  Timing/emission logic lives in
+``benchmarks/harness.py``, shared with the per-config scripts under
+``benchmarks/``.
 """
 
 from __future__ import annotations
 
-import json
 import sys
-import time
 
 # First recorded values per (platform, config) so vs_baseline always
 # compares like with like.  TPU: one v5e chip, gpt2-small (seq 1024,
@@ -34,10 +34,8 @@ TIMED_STEPS = 30
 
 def main() -> None:
     import jax
-    import numpy as np
 
-    from ray_lightning_tpu import Trainer
-    from ray_lightning_tpu.core.callbacks import Callback
+    from benchmarks.harness import run_steps_per_sec
     from ray_lightning_tpu.models.gpt import CONFIGS, GPTLightningModule
 
     platform = jax.devices()[0].platform
@@ -52,40 +50,8 @@ def main() -> None:
     module = GPTLightningModule(
         cfg, dataset_size=batch * (WARMUP_STEPS + TIMED_STEPS),
         batch_size=batch)
-
-    class Timer(Callback):
-        def __init__(self):
-            self.t0 = None
-            self.elapsed = None
-
-        def on_train_batch_end(self, trainer, mod, metrics, batch, idx):
-            # device→host fetch of the loss scalar is the sync point
-            # (block_until_ready does not reliably drain remote-tunnel
-            # platforms, so fetch a value instead)
-            if trainer.global_step == WARMUP_STEPS:
-                float(np.asarray(metrics["loss"]))
-                self.t0 = time.monotonic()
-            elif trainer.global_step == WARMUP_STEPS + TIMED_STEPS:
-                float(np.asarray(metrics["loss"]))
-                self.elapsed = time.monotonic() - self.t0
-
-    timer = Timer()
-    trainer = Trainer(
-        max_steps=WARMUP_STEPS + TIMED_STEPS, max_epochs=1,
-        enable_checkpointing=False, num_sanity_val_steps=0,
-        limit_val_batches=0, log_every_n_steps=10**9,
-        callbacks=[timer], seed=0)
-    trainer.fit(module)
-
-    assert timer.elapsed is not None, "benchmark did not reach timed steps"
-    steps_per_sec = TIMED_STEPS / timer.elapsed
-    baseline = BASELINES.get(metric, steps_per_sec)
-    print(json.dumps({
-        "metric": metric,
-        "value": round(steps_per_sec, 3),
-        "unit": "steps/sec",
-        "vs_baseline": round(steps_per_sec / baseline, 3),
-    }))
+    run_steps_per_sec(module, metric, warmup=WARMUP_STEPS,
+                      timed=TIMED_STEPS, baseline=BASELINES.get(metric))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,30 @@
+"""BASELINE config #4: BERT-base masked-LM under ZeRO-1 sharding.
+
+On one chip the zero1 annotations are identity (nothing to shard
+across), so this measures the sharded code path's single-chip cost;
+multi-chip runs shard optimizer state across the data axis.
+
+    python -m benchmarks.bench_bert_zero1
+"""
+
+import jax
+
+from benchmarks.harness import run_steps_per_sec
+
+BASELINES = {"tpu": 8.4}   # first v5e measurement, B=32 T=128 bert-base
+
+
+def main():
+    from ray_lightning_tpu.models.bert import BertMLMModule
+
+    platform = jax.devices()[0].platform
+    batch = 32 if platform != "cpu" else 4
+    cfg = "bert-base" if platform != "cpu" else "tiny"
+    module = BertMLMModule(cfg, batch_size=batch, train_size=batch * 40)
+    run_steps_per_sec(module,
+                      f"bert_{cfg}_zero1_b{batch}_steps_per_sec_{platform}",
+                      strategy="zero1", baseline=BASELINES.get(platform))
+
+
+if __name__ == "__main__":
+    main()

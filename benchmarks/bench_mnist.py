@@ -1,0 +1,31 @@
+"""BASELINE config #1: the MNIST classifier, steps/sec.
+
+The reference's flagship example workload (examples/ray_ddp_example.py);
+tiny by design — this measures per-step framework overhead more than
+compute.
+
+    python -m benchmarks.bench_mnist
+"""
+
+import jax
+
+from benchmarks.harness import run_steps_per_sec
+
+# first v5e measurement, B=128 MLP: per-step host dispatch through
+# the device tunnel dominates at this size (compute is microseconds)
+BASELINES = {"tpu": 63.9}
+
+
+def main():
+    from ray_lightning_tpu.models import LightningMNISTClassifier
+
+    platform = jax.devices()[0].platform
+    batch = 128
+    module = LightningMNISTClassifier(config={"batch_size": batch},
+                                      train_size=batch * 40)
+    run_steps_per_sec(module, f"mnist_b{batch}_steps_per_sec_{platform}",
+                      timed=100, baseline=BASELINES.get(platform))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""BASELINE config #2: ResNet-50 / CIFAR-10-shaped data, steps/sec/chip.
+
+    python -m benchmarks.bench_resnet50
+"""
+
+import jax
+
+from benchmarks.harness import run_steps_per_sec
+
+# first TPU measurement of this exact config (v5e chip, B=128, 32x32,
+# NHWC bf16) — later rounds compare against it
+BASELINES = {"tpu": 26.4}
+
+
+def main():
+    from ray_lightning_tpu.models.resnet import ResNetLightningModule
+
+    platform = jax.devices()[0].platform
+    batch = 128 if platform != "cpu" else 8
+    cfg = "resnet50" if platform != "cpu" else "resnet18"
+    module = ResNetLightningModule(cfg, batch_size=batch,
+                                   train_size=batch * 40)
+    run_steps_per_sec(module, f"{cfg}_b{batch}_steps_per_sec_{platform}",
+                      baseline=BASELINES.get(platform))
+
+
+if __name__ == "__main__":
+    main()

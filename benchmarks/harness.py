@@ -1,0 +1,55 @@
+"""Shared benchmark harness: time steady-state training steps through
+the full framework path (Trainer → compiled SPMD step) and print one
+JSON line per metric, the same contract as the repo-root ``bench.py``.
+
+The BASELINE configs (BASELINE.md) are each covered by a script in this
+directory; ``python -m benchmarks.bench_resnet50`` etc.  The timing
+method matches bench.py: warmup to steady state, then fetch a loss
+scalar as the device sync point (block_until_ready does not reliably
+drain remote-tunnel platforms).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
+                      timed: int = 30, baseline: "float | None" = None,
+                      strategy=None, trainer_kwargs=None) -> dict:
+    from ray_lightning_tpu import Trainer
+    from ray_lightning_tpu.core.callbacks import Callback
+
+    class Timer(Callback):
+        def __init__(self):
+            self.t0 = None
+            self.elapsed = None
+
+        def on_train_batch_end(self, trainer, mod, metrics, batch, idx):
+            if trainer.global_step == warmup:
+                float(np.asarray(metrics["loss"]))
+                self.t0 = time.monotonic()
+            elif trainer.global_step == warmup + timed:
+                float(np.asarray(metrics["loss"]))
+                self.elapsed = time.monotonic() - self.t0
+
+    timer = Timer()
+    trainer = Trainer(
+        max_steps=warmup + timed, max_epochs=10**6, strategy=strategy,
+        enable_checkpointing=False, num_sanity_val_steps=0,
+        limit_val_batches=0, log_every_n_steps=10**9, callbacks=[timer],
+        seed=0, **(trainer_kwargs or {}))
+    trainer.fit(module)
+    assert timer.elapsed is not None, "did not reach timed steps"
+    steps_per_sec = timed / timer.elapsed
+    result = {
+        "metric": metric,
+        "value": round(steps_per_sec, 3),
+        "unit": "steps/sec",
+        "vs_baseline": round(steps_per_sec / (baseline or steps_per_sec), 3),
+    }
+    print(json.dumps(result))
+    return result

@@ -10,6 +10,7 @@ reusability afterwards.
 """
 
 import os
+import threading
 
 import pytest
 
@@ -26,6 +27,28 @@ def _trainer(cb):
                    log_every_n_steps=1)
 
 
+def _fit_must_raise_within(trainer, module, timeout_s):
+    """Watchdog: the fit must RAISE within the window — a driver that
+    blocks forever on a dead worker's future is this test's failure
+    mode, so a wedge fails attributably instead of eating CI's budget."""
+    box = {}
+
+    def run():
+        try:
+            trainer.fit(module)
+            box["outcome"] = "returned"
+        except Exception as e:   # noqa: BLE001 - any error is a pass
+            box["outcome"] = "raised"
+            box["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    assert not t.is_alive(), f"fit hung > {timeout_s}s on a dead worker"
+    assert box.get("outcome") == "raised", "fit returned instead of raising"
+    return box["error"]
+
+
 def test_worker_hard_crash_raises_not_hangs():
     class DieInWorker(Callback):
         """Hard-kills the worker (no exception, no teardown)."""
@@ -33,8 +56,7 @@ def test_worker_hard_crash_raises_not_hangs():
         def on_train_batch_end(self, trainer, module, outputs, batch, idx):
             os._exit(17)
 
-    with pytest.raises(Exception):
-        _trainer(DieInWorker()).fit(BoringModel())
+    _fit_must_raise_within(_trainer(DieInWorker()), BoringModel(), 240)
 
 
 def test_driver_usable_after_worker_failure():
