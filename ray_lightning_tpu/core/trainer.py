@@ -32,6 +32,7 @@ from typing import Any, Optional
 
 import fsspec
 import jax
+import jax.numpy as jnp
 import numpy as np
 import optax
 from flax import serialization
@@ -55,6 +56,12 @@ _RUNTIME_FIELDS = (
     "state", "_mesh", "_train_step", "_eval_steps", "_predict_step",
     "_state_shardings", "_abstract_state", "_tx", "_init_fn", "_init_rng",
 )
+
+# every spelling (PL 1.x and 2.x) that means "half-precision inputs";
+# on TPU they all resolve to bfloat16 (no loss-scaling machinery)
+_BF16_PRECISIONS = ("bf16", "bf16-mixed", "bf16-true",
+                    "16", "16-mixed", "16-true")
+_FP32_PRECISIONS = ("32", "32-true", "64")
 
 
 class Trainer:
@@ -105,6 +112,10 @@ class Trainer:
         self.accumulate_grad_batches = max(1, accumulate_grad_batches)
         self.gradient_clip_val = gradient_clip_val
         self.precision = str(precision)
+        if self.precision not in _BF16_PRECISIONS + _FP32_PRECISIONS:
+            raise ValueError(
+                f"Unknown precision {precision!r}; use one of "
+                f"{_BF16_PRECISIONS + _FP32_PRECISIONS}")
         self.seed = seed
         self.resume_from_checkpoint = resume_from_checkpoint
         self.use_distributed_sampler = use_distributed_sampler
@@ -368,14 +379,26 @@ class Trainer:
         to a global array — the TPU-native equivalent of DistributedSampler
         feeding per-rank DDP replicas.  Single-process: numpy passes
         straight into the jitted step, whose ``in_shardings`` shard it
-        during dispatch."""
+        during dispatch.
+
+        ``Trainer(precision="bf16")`` casts floating batch leaves to
+        bfloat16 here (halving host→device transfer); parameter/compute
+        dtypes belong to the model config (e.g. ``GPTConfig.dtype``) —
+        on TPU there is no loss-scaling AMP machinery to port, bf16 runs
+        natively on the MXU (reference precision flow: PL AMP +
+        ShardedGradScaler, ray_ddp_sharded.py:26-29).
+        """
+        batch = jax.tree_util.tree_map(np.asarray, batch)
+        if self.precision in _BF16_PRECISIONS:
+            batch = jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.bfloat16)
+                if np.issubdtype(x.dtype, np.floating) else x, batch)
         if jax.process_count() > 1:
             shardings = strategy.batch_shardings(self._mesh, batch)
             return jax.tree_util.tree_map(
-                lambda x, s: jax.make_array_from_process_local_data(
-                    s, np.asarray(x)),
+                lambda x, s: jax.make_array_from_process_local_data(s, x),
                 batch, shardings)
-        return jax.tree_util.tree_map(np.asarray, batch)
+        return batch
 
     def _batch_ok(self, batch, strategy) -> bool:
         """Leading dim must divide over data shards (XLA static shapes)."""

@@ -1,0 +1,135 @@
+"""Feature-composition matrix: trainer options × sharding strategies.
+
+Individually-tested features (gradient accumulation, precision, grad
+clipping) must keep working when combined with non-default strategies —
+the combinations users actually run.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import Trainer
+from ray_lightning_tpu.core.module import LightningModule
+from ray_lightning_tpu.models import BoringModel
+from ray_lightning_tpu.models.gpt import (GPTLightningModule,
+                                          gpt_partition_rules)
+from ray_lightning_tpu.parallel.strategy import SpmdStrategy
+
+
+def _fit(strategy=None, **kw):
+    module = kw.pop("module", None) or BoringModel(batch_size=8)
+    trainer = Trainer(max_epochs=1, limit_train_batches=4,
+                      limit_val_batches=0, num_sanity_val_steps=0,
+                      enable_checkpointing=False, seed=0,
+                      log_every_n_steps=1, strategy=strategy, **kw)
+    trainer.fit(module)
+    assert np.isfinite(float(trainer.callback_metrics["loss"]))
+    return trainer
+
+
+@pytest.mark.parametrize("strategy", ["ddp", "zero1", "fsdp"])
+def test_grad_accumulation_with_strategies(strategy, seed):
+    t = _fit(strategy=strategy, accumulate_grad_batches=2)
+    assert t.global_step == 4
+
+
+def test_grad_accumulation_with_spmd_mesh(seed):
+    module = GPTLightningModule("tiny", dataset_size=32, batch_size=8)
+    strategy = SpmdStrategy(rules=gpt_partition_rules(),
+                            axis_names=("data", "tensor"),
+                            axis_sizes={"tensor": 2})
+    t = _fit(strategy=strategy, module=module, accumulate_grad_batches=2)
+    assert t.global_step > 0
+
+
+def test_accumulation_matches_large_batch(seed):
+    """k microbatches of size b must produce the same first-step update
+    as one batch of size k*b (gradient averaging correctness) — checked
+    through the full Trainer path with a deterministic SGD module."""
+    import optax
+
+    class Linear(LightningModule):
+        def __init__(self, batch_size):
+            super().__init__()
+            self.batch_size = batch_size
+
+        def configure_model(self):
+            import flax.linen as nn
+            return nn.Dense(2)
+
+        def configure_optimizers(self):
+            return optax.sgd(0.1)
+
+        def training_step(self, ctx, batch):
+            x, y = batch
+            loss = ((ctx.apply(x) - y) ** 2).mean()
+            ctx.log("loss", loss)
+            return loss
+
+        def train_dataloader(self):
+            from ray_lightning_tpu.core.data import ArrayDataset, DataLoader
+            rng = np.random.default_rng(0)
+            x = rng.normal(size=(16, 4)).astype(np.float32)
+            y = rng.normal(size=(16, 2)).astype(np.float32)
+            return DataLoader(ArrayDataset(x, y),
+                              batch_size=self.batch_size, drop_last=True)
+
+    def one_step(batch_size, accum):
+        m = Linear(batch_size)
+        t = Trainer(max_steps=1, max_epochs=1, enable_checkpointing=False,
+                    num_sanity_val_steps=0, limit_val_batches=0, seed=0,
+                    accumulate_grad_batches=accum, log_every_n_steps=1)
+        t.fit(m)
+        return jax.tree_util.tree_map(np.asarray, t.state.params)
+
+    p_accum = one_step(batch_size=16, accum=4)   # 4 microbatches of 4
+    p_big = one_step(batch_size=16, accum=1)     # one batch of 16
+    for a, b in zip(jax.tree_util.tree_leaves(p_accum),
+                    jax.tree_util.tree_leaves(p_big)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_bf16_precision_casts_batch(seed):
+    """Trainer(precision="bf16") must deliver bfloat16 floating inputs
+    to the step (integer leaves untouched)."""
+    seen = {}
+
+    class Probe(LightningModule):
+        batch_size = 8
+
+        def configure_model(self):
+            import flax.linen as nn
+            return nn.Dense(2)
+
+        def configure_optimizers(self):
+            import optax
+            return optax.sgd(0.01)
+
+        def training_step(self, ctx, batch):
+            x, y = batch
+            seen["x"] = x.dtype
+            seen["y"] = y.dtype
+            loss = (ctx.apply(x.astype(jnp.float32)) ** 2).mean()
+            ctx.log("loss", loss)
+            return loss
+
+        def train_dataloader(self):
+            from ray_lightning_tpu.core.data import ArrayDataset, DataLoader
+            x = np.zeros((16, 4), np.float32)
+            y = np.zeros((16,), np.int32)
+            return DataLoader(ArrayDataset(x, y), batch_size=8,
+                              drop_last=True)
+
+    t = Trainer(max_steps=1, max_epochs=1, enable_checkpointing=False,
+                num_sanity_val_steps=0, limit_val_batches=0, seed=0,
+                precision="bf16", log_every_n_steps=1)
+    t.fit(Probe())
+    assert seen["x"] == jnp.bfloat16
+    assert seen["y"] == jnp.int32
+
+
+def test_grad_clipping_with_zero1(seed):
+    t = _fit(strategy="zero1", gradient_clip_val=0.5)
+    assert t.global_step == 4
