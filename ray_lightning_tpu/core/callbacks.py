@@ -214,8 +214,9 @@ class ShardedCheckpoint(Callback):
         if n and (trainer.current_epoch + 1) % n == 0:
             self._save(trainer)
 
-    def on_train_end(self, trainer, module) -> None:
-        trainer.wait_for_checkpoints()
+    # no on_train_end wait needed: the trainer's fit finalization waits
+    # on and closes every sharded checkpointer it opened
+    # (trainer._close_sharded_checkpointers)
 
 
 class EarlyStopping(Callback):

@@ -388,11 +388,15 @@ class Trainer:
         natively on the MXU (reference precision flow: PL AMP +
         ShardedGradScaler, ray_ddp_sharded.py:26-29).
         """
-        batch = jax.tree_util.tree_map(np.asarray, batch)
-        if self.precision in _BF16_PRECISIONS:
-            batch = jax.tree_util.tree_map(
-                lambda x: x.astype(jnp.bfloat16)
-                if np.issubdtype(x.dtype, np.floating) else x, batch)
+        cast_bf16 = self.precision in _BF16_PRECISIONS
+
+        def to_host(x):
+            a = np.asarray(x)
+            if cast_bf16 and np.issubdtype(a.dtype, np.floating):
+                a = a.astype(jnp.bfloat16)
+            return a
+
+        batch = jax.tree_util.tree_map(to_host, batch)
         if jax.process_count() > 1:
             shardings = strategy.batch_shardings(self._mesh, batch)
             return jax.tree_util.tree_map(
