@@ -1,0 +1,31 @@
+"""Beyond-parity workload: MoE GPT (routed FFN, ops/moe.py), steps/sec.
+
+Single-chip this measures the routed-FFN cost (static-capacity
+dispatch/combine einsums + per-expert FFN); multi-chip runs shard the
+expert dim on the ``expert`` mesh axis and the same einsums lower to
+the token all-to-all.
+
+    python -m benchmarks.bench_moe
+"""
+
+import jax
+
+from benchmarks.harness import run_steps_per_sec
+
+BASELINES = {"tpu": 8.9}   # first v5e measurement, gpt2-moe-8e B=8 T=1024
+
+
+def main():
+    from ray_lightning_tpu.models.gpt import GPTLightningModule
+
+    platform = jax.devices()[0].platform
+    cfg = "gpt2-moe-8e" if platform != "cpu" else "moe-tiny"
+    batch = 8
+    module = GPTLightningModule(cfg, batch_size=batch,
+                                dataset_size=batch * 40)
+    run_steps_per_sec(module, f"{cfg}_b{batch}_steps_per_sec_{platform}",
+                      baseline=BASELINES.get(platform))
+
+
+if __name__ == "__main__":
+    main()

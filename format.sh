@@ -13,7 +13,7 @@ cd "$(dirname "$0")"
 FLAKE8_ARGS=(--max-line-length=88 --extend-ignore=E203,W503)
 
 if [[ "${1:-}" == "--all" ]]; then
-    exec flake8 "${FLAKE8_ARGS[@]}" ray_lightning_tpu tests
+    exec flake8 "${FLAKE8_ARGS[@]}" ray_lightning_tpu tests benchmarks bench.py __graft_entry__.py
 fi
 
 MERGEBASE="$(git merge-base origin/main HEAD 2>/dev/null \

@@ -507,6 +507,11 @@ class Trainer:
             module.on_fit_end()
             for cb in self.callbacks:
                 cb.on_fit_end(self, module)
+            # in-flight async sharded saves must become durable (and
+            # their orbax worker threads released) even when the fit is
+            # unwinding on an exception — _finalize_fit only runs on the
+            # happy path
+            self._close_sharded_checkpointers()
         return self._finalize_fit(module)
 
     def _max_steps_reached(self) -> bool:
@@ -723,7 +728,6 @@ class Trainer:
     # -- finalization / results round-trip -------------------------------
 
     def _finalize_fit(self, module):
-        self._close_sharded_checkpointers()
         self._flush_epoch_metrics()
         trained = {"params": fetch_tree(self.state.params),
                    "model_state": fetch_tree(self.state.model_state)}

@@ -167,6 +167,30 @@ def test_callback_state_roundtrips_through_sharded_meta(tmp_path, seed):
     assert es2.wait_count == 2
 
 
+def test_inflight_save_durable_when_fit_raises(tmp_path, seed):
+    """An async save kicked off right before a training exception must
+    still land on disk — the fit-loop finally waits on and closes the
+    checkpointers even while unwinding."""
+    from ray_lightning_tpu.core.callbacks import Callback
+
+    class SaveThenBoom(Callback):
+        def on_train_batch_end(self, trainer, module, outputs, batch, idx):
+            if trainer.global_step == 2:
+                trainer.save_sharded_checkpoint(str(tmp_path / "cks"))
+                raise RuntimeError("post-save boom")
+
+    trainer = Trainer(max_epochs=1, enable_checkpointing=False,
+                      num_sanity_val_steps=0, limit_val_batches=0,
+                      log_every_n_steps=1, callbacks=[SaveThenBoom()],
+                      default_root_dir=str(tmp_path), seed=0)
+    with pytest.raises(RuntimeError, match="post-save boom"):
+        trainer.fit(BoringModel())
+    assert trainer._sharded_checkpointers == {}   # closed during unwind
+    ck = ShardedCheckpointer(str(tmp_path / "cks"))
+    assert ck.all_steps() == [2]                  # save became durable
+    ck.close()
+
+
 def test_restore_missing_dir_raises(tmp_path):
     ck = ShardedCheckpointer(str(tmp_path / "empty"))
     with pytest.raises(FileNotFoundError):
