@@ -501,17 +501,20 @@ class Trainer:
                     if not self.should_stop else epoch
             # else: zero epochs ran (resumed at max_steps) — the restored
             # epoch counter must not drift upward per save/resume cycle
-            module.on_train_end()
-            for cb in self.callbacks:
-                cb.on_train_end(self, module)
-            module.on_fit_end()
-            for cb in self.callbacks:
-                cb.on_fit_end(self, module)
-            # in-flight async sharded saves must become durable (and
-            # their orbax worker threads released) even when the fit is
-            # unwinding on an exception — _finalize_fit only runs on the
-            # happy path
-            self._close_sharded_checkpointers()
+            try:
+                module.on_train_end()
+                for cb in self.callbacks:
+                    cb.on_train_end(self, module)
+                module.on_fit_end()
+                for cb in self.callbacks:
+                    cb.on_fit_end(self, module)
+            finally:
+                # in-flight async sharded saves must become durable (and
+                # their orbax worker threads released) unconditionally —
+                # even when the fit is unwinding on an exception or a
+                # user hook raises during the unwind; _finalize_fit only
+                # runs on the happy path
+                self._close_sharded_checkpointers()
         return self._finalize_fit(module)
 
     def _max_steps_reached(self) -> bool:
