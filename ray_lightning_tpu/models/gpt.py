@@ -202,7 +202,10 @@ def gpt_partition_rules(tensor_axis: str = "tensor") -> list[tuple[str, P]]:
         (r"attn/proj/kernel", P(tensor_axis, None)),
         (r"mlp/fc/kernel", P(None, tensor_axis)),
         (r"mlp/out/kernel", P(tensor_axis, None)),
-        (r"wpe", P()),
+        # no wpe rule: position embeddings fall through to the fsdp
+        # fallback — sharded when an fsdp axis exists (at T=2048 C=2048
+        # they are 4M params; pinning them replicated was waste),
+        # replicated otherwise
     ]
 
 
