@@ -66,6 +66,32 @@ def test_all_large_1p3b_params_have_sharding_rules():
     _assert_large_leaves_sharded(CONFIGS["gpt2-1p3b"])
 
 
+def test_all_large_bert_large_params_have_sharding_rules():
+    from ray_lightning_tpu.models.bert import (CONFIGS as BERT_CONFIGS,
+                                               BertForMaskedLM,
+                                               bert_partition_rules)
+
+    cfg = BERT_CONFIGS["bert-large"]
+    model = BertForMaskedLM(cfg)
+    tokens = jax.ShapeDtypeStruct((2, cfg.max_len), jnp.int32)
+    params = jax.eval_shape(model.init, jax.random.PRNGKey(0),
+                            tokens)["params"]
+    strategy = SpmdStrategy(rules=bert_partition_rules(),
+                            axis_names=("data", "fsdp", "tensor"),
+                            axis_sizes={"fsdp": 2, "tensor": 2})
+    mesh = strategy.build_mesh()
+    checked = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        if math.prod(leaf.shape) < 10**6:
+            continue
+        path_str = _path_str(path)
+        spec = strategy.param_spec(mesh, path_str, leaf)
+        assert any(e is not None for e in spec), (
+            f"{path_str} {leaf.shape} would replicate on every chip")
+        checked += 1
+    assert checked > 0
+
+
 def test_all_large_moe_params_have_sharding_rules():
     _assert_large_leaves_sharded(CONFIGS["gpt2-moe-8e"])
 
