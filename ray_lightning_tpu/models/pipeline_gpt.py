@@ -144,6 +144,9 @@ class PipelinedGPT(LightningModule):
     def validation_step(self, ctx, batch):
         ctx.log("val_loss", self._loss(ctx, batch))
 
+    def test_step(self, ctx, batch):
+        ctx.log("test_loss", self._loss(ctx, batch))
+
     def predict_step(self, ctx, batch):
         x = batch[0] if isinstance(batch, (tuple, list)) else batch
         return jnp.argmax(self._forward(ctx.params, x), axis=-1)
@@ -160,3 +163,9 @@ class PipelinedGPT(LightningModule):
 
     def val_dataloader(self):
         return self._loader(1)
+
+    def test_dataloader(self):
+        return self._loader(2)
+
+    def predict_dataloader(self):
+        return self._loader(3)

@@ -172,6 +172,26 @@ def test_pipelined_gpt_trains_and_shards(seed):
     assert "val_loss" in trainer.callback_metrics
 
 
+def test_pipelined_gpt_predict(seed):
+    """predict on the stage mesh returns dataset-order token ids."""
+    from ray_lightning_tpu import Trainer
+    from ray_lightning_tpu.models.pipeline_gpt import PipelinedGPT
+
+    module = PipelinedGPT("tiny", n_microbatches=2, dataset_size=16,
+                          batch_size=8)
+    trainer = Trainer(max_epochs=1, strategy=PipelineStrategy(stages=2),
+                      enable_checkpointing=False, num_sanity_val_steps=0,
+                      limit_val_batches=0, log_every_n_steps=1, seed=0)
+    trainer.fit(module)
+    preds = trainer.predict(module)
+    assert len(preds) == 2
+    for p in preds:
+        p = np.asarray(p)
+        assert p.shape == (8, module.config.block_size)
+        assert p.dtype.kind == "i"
+        assert (p >= 0).all() and (p < module.config.vocab_size).all()
+
+
 def test_pipelined_gpt_same_loss_as_unpipelined(seed):
     """One train step on (data=2, stage=2) must produce the same loss as
     the identical model on a data-only mesh (scheduling ≠ semantics)."""
