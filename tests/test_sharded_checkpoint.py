@@ -167,6 +167,19 @@ def test_callback_state_roundtrips_through_sharded_meta(tmp_path, seed):
     assert es2.wait_count == 2
 
 
+def test_max_to_keep_evicts_oldest(tmp_path, seed):
+    trainer = _fit(str(tmp_path), max_steps=1)
+    ck = ShardedCheckpointer(str(tmp_path / "cks"), max_to_keep=2)
+    for step in (1, 2, 3):
+        ck.save(step, trainer.state, {"global_step": step})
+    ck.wait()
+    assert ck.all_steps() == [2, 3]   # oldest evicted
+    state, meta = ck.restore(
+        abstract_like(trainer.state, trainer._state_shardings))
+    assert meta["global_step"] == 3
+    ck.close()
+
+
 def test_inflight_save_durable_when_fit_raises(tmp_path, seed):
     """An async save kicked off right before a training exception must
     still land on disk — the fit-loop finally waits on and closes the
