@@ -265,7 +265,15 @@ class LightningModule:
     def on_validation_epoch_start(self) -> None: ...
     def on_validation_epoch_end(self) -> None: ...
     def on_save_checkpoint(self, checkpoint: dict) -> None: ...
-    def on_load_checkpoint(self, checkpoint: dict) -> None: ...
+
+    def on_load_checkpoint(self, checkpoint: dict) -> None:
+        """``checkpoint`` carries the top-level keys of
+        :meth:`Trainer.dump_checkpoint` (epoch, global_step, hparams,
+        callbacks, world_size, strategy).  ``checkpoint["state"]`` is
+        present only when resuming from a single-file msgpack
+        checkpoint; sharded (orbax) restores stream arrays straight to
+        device shards, so the hook sees the metadata without a
+        host-materialized state dict."""
 
     # -- trainer-delegated conveniences ------------------------------------
 

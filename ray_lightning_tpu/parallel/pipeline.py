@@ -79,7 +79,11 @@ def _pipeline_inner(params_loc, x_loc, *, stage_fn, axis_name,
     (_, outs), _ = lax.scan(step, init, jnp.arange(M + S - 1))
     # only the last stage holds real outputs; broadcast them so the
     # (replicated-over-stage) downstream head/loss sees one consistent
-    # value — gradients flow back only into stage S-1's contribution
+    # value — gradients flow back only into stage S-1's contribution.
+    # psum-of-masked-zeros IS the broadcast here: XLA has no one-hop
+    # pbroadcast primitive, a ppermute chain costs S-1 serial hops, and
+    # a log-tree of ppermutes moves log2(S)*|outs| per link vs the ring
+    # all-reduce's 2(S-1)/S*|outs| — psum wins for S>=4 and ties below.
     outs = lax.psum(
         jnp.where(sid == S - 1, outs, jnp.zeros_like(outs)), axis_name)
     return outs.reshape((B,) + x_loc.shape[1:])

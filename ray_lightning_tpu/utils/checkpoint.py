@@ -59,6 +59,7 @@ class ShardedCheckpointer:
     def __init__(self, directory: str, async_save: bool = True,
                  max_to_keep: Optional[int] = None):
         self.directory = directory
+        self.max_to_keep = max_to_keep
         self._mgr = _manager(directory, async_save, max_to_keep)
 
     # -- save ------------------------------------------------------------
@@ -111,6 +112,9 @@ class ShardedCheckpointer:
 
     @staticmethod
     def _dir_entries(path: str) -> "Optional[list[str]]":
+        # Detection must degrade to "not a sharded checkpoint" on ANY
+        # listing failure: remote fsspec backends (gcsfs etc.) raise
+        # non-OSError exceptions, and this runs on every restore.
         try:
             if "://" in path:
                 import fsspec
@@ -120,7 +124,7 @@ class ShardedCheckpointer:
                 return [os.path.basename(e.rstrip("/")) for e in fs.ls(p)]
             if os.path.isdir(path):
                 return os.listdir(path)
-        except OSError:
+        except Exception:
             pass
         return None
 

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import csv
 import os
+import tempfile
 import uuid
 
 
@@ -102,14 +103,27 @@ class CSVLogger:
             self._rewrite_with_new_header()
         os.makedirs(self.log_dir, exist_ok=True)
         mode = "a" if self._started else "w"
+        if mode == "w":
+            # Invariant: a sidecar naming run R exists only while the
+            # csv holds R's rows.  Unlink first, write the csv, then
+            # write the sidecar atomically — a crash anywhere in the
+            # sequence leaves "no owner" (the next writer overwrites),
+            # never a sidecar pointing at another run's rows (cross-run
+            # mixing) and never a run truncating its own partial file.
+            try:
+                os.remove(self._runid_path)
+            except FileNotFoundError:
+                pass
         with open(self.path, mode, newline="") as f:
             writer = csv.DictWriter(f, fieldnames=self._fields, restval="")
             if mode == "w":
                 writer.writeheader()
             writer.writerow(row)
         if mode == "w":
-            with open(self._runid_path, "w") as f:
+            fd, tmp = tempfile.mkstemp(dir=self.log_dir)
+            with os.fdopen(fd, "w") as f:
                 f.write(self._run_id)
+            os.replace(tmp, self._runid_path)
         self._started = True
 
     def _rewrite_with_new_header(self) -> None:
