@@ -110,16 +110,29 @@ _backend: Optional[ClusterBackend] = None
 def get_backend(prefer_ray: bool = True) -> ClusterBackend:
     """Return the process-wide backend, creating one if needed.
 
-    Prefers a real Ray runtime when importable (and initializes it,
-    matching ``ray.init()``-if-needed at ray_ddp.py:125-126); falls back
-    to the built-in local backend.
+    Selection order: the ``RLT_BACKEND`` env var when set (``ray`` —
+    require a real Ray runtime, error if not importable; ``local`` —
+    force the built-in backend even when Ray is present); otherwise
+    prefer a real Ray runtime when importable (and initialize it,
+    matching ``ray.init()``-if-needed at ray_ddp.py:125-126), falling
+    back to the built-in local backend.
     """
+    import os
+
     global _backend
     with _backend_lock:
         if _backend is not None:
             return _backend
-        if prefer_ray:
+        choice = os.environ.get("RLT_BACKEND", "").strip().lower()
+        if choice and choice not in ("ray", "local"):
+            raise ValueError(
+                f"RLT_BACKEND={choice!r}; expected 'ray' or 'local'")
+        if choice == "ray" or (choice != "local" and prefer_ray):
             from ray_lightning_tpu.utils.imports import RAY_AVAILABLE
+            if not RAY_AVAILABLE and choice == "ray":
+                raise ImportError(
+                    "RLT_BACKEND=ray but Ray is not installed; "
+                    "pip install 'ray[tune]' or unset RLT_BACKEND.")
             if RAY_AVAILABLE:
                 from ray_lightning_tpu.cluster.ray_backend import RayBackend
                 _backend = RayBackend()

@@ -13,6 +13,8 @@ gates it), so the hard ``import ray`` here is safe.
 
 from __future__ import annotations
 
+import os
+import threading
 from typing import Any, Optional
 
 import ray
@@ -26,6 +28,71 @@ from ray_lightning_tpu.cluster.backend import (
 from ray_lightning_tpu.cluster.queue import RayQueueProxy
 
 
+class _CallResolver:
+    """One daemon thread resolving ALL in-flight actor calls.
+
+    A thread per call is the wrong shape at pod scale (128 actors ×
+    several calls each = hundreds of threads); here every pending
+    ObjectRef sits in one table that a single thread drains with
+    ``ray.wait`` — O(1) threads regardless of fan-out.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending: dict[Any, Future] = {}
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def submit(self, ref: Any, fut: Future) -> None:
+        with self._lock:
+            self._pending[ref] = fut
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="rlt-ray-resolver", daemon=True)
+                self._thread.start()
+        self._wake.set()
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                refs = list(self._pending)
+            if not refs:
+                self._wake.wait()
+                self._wake.clear()
+                continue
+            try:
+                # short timeout so newly submitted refs join the wait set
+                ready, _ = ray.wait(
+                    refs, num_returns=len(refs), timeout=0.05)
+            except BaseException as e:  # noqa: BLE001
+                # wait-level failure (e.g. ray.shutdown with calls in
+                # flight): fail the futures whose refs were in THIS wait
+                # so their callers see the error instead of hanging —
+                # calls submitted after the snapshot (possibly against a
+                # re-initialized Ray) stay pending and get a fresh wait.
+                doomed = []
+                with self._lock:
+                    for ref in refs:
+                        fut = self._pending.pop(ref, None)
+                        if fut is not None:
+                            doomed.append(fut)
+                for fut in doomed:
+                    fut.set_error(e)
+                continue
+            for ref in ready:
+                with self._lock:
+                    fut = self._pending.pop(ref, None)
+                if fut is None:
+                    continue
+                try:
+                    fut.set_result(ray.get(ref))
+                except BaseException as e:  # noqa: BLE001 - to caller
+                    fut.set_error(e)
+
+
+_resolver = _CallResolver()
+
+
 class RayActorHandle(ActorHandle):
     def __init__(self, actor):
         self._actor = actor
@@ -34,15 +101,7 @@ class RayActorHandle(ActorHandle):
     def call(self, method: str, *args, **kwargs) -> Future:
         ref = getattr(self._actor, method).remote(*args, **kwargs)
         fut = Future()
-
-        def _resolve():
-            try:
-                fut.set_result(ray.get(ref))
-            except BaseException as e:  # noqa: BLE001 - relayed to caller
-                fut.set_error(e)
-
-        import threading
-        threading.Thread(target=_resolve, daemon=True).start()
+        _resolver.submit(ref, fut)
         return fut
 
     def kill(self) -> None:
@@ -52,9 +111,25 @@ class RayActorHandle(ActorHandle):
 class RayBackend(ClusterBackend):
     supports_object_store = True
 
-    def __init__(self):
+    def __init__(self, address: Optional[str] = None):
+        """Connect to (or start) a Ray runtime.
+
+        ``address`` — explicit cluster address, including Ray Client
+        URIs (``ray://host:10001``, the pickle-over-gRPC path the
+        reference tests in tests/test_client*.py).  Defaults to the
+        ``RLT_RAY_ADDRESS`` / ``RAY_ADDRESS`` env vars; unset means a
+        fresh local runtime (bare ``ray.init()``, ray_ddp.py:125-126).
+        An already-initialized runtime (user called ``ray.init``
+        themselves, client or not) is used as-is.
+        """
         if not ray.is_initialized():
-            ray.init()
+            address = (address
+                       or os.environ.get("RLT_RAY_ADDRESS")
+                       or os.environ.get("RAY_ADDRESS"))
+            if address:
+                ray.init(address=address)
+            else:
+                ray.init()
         self._queue: Optional[RayQueue] = None
 
     def _ensure_queue(self) -> RayQueue:

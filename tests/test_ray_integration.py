@@ -1,0 +1,160 @@
+"""Real-Ray integration suite (VERDICT round-1 missing #2/#3).
+
+Skipped entirely unless a genuine Ray runtime is importable — the
+ray-integration CI job installs ``ray[tune]`` and runs this file plus
+the distributed suite under ``RLT_BACKEND=ray``.  Everything here
+executes against REAL Ray: real actors, real ``ray.util.queue``, real
+``runtime_env`` propagation, and (for the Tune tests) genuine
+``ray.tune.run`` trials — the parts the stub tests in
+test_ray_backend.py / test_ray_tune_bridge.py can only shape-check.
+Reference analogs: tests/test_ddp.py:19-38 fixtures,
+tests/test_tune.py, tests/test_client.py:10-14.
+"""
+
+import os
+
+import pytest
+
+ray = pytest.importorskip("ray")
+
+from ray_lightning_tpu import RayXlaPlugin, Trainer  # noqa: E402
+from ray_lightning_tpu import tune as rlt_tune  # noqa: E402
+from ray_lightning_tpu.models import BoringModel  # noqa: E402
+
+
+@pytest.fixture
+def ray_backend_env(monkeypatch):
+    """A fresh local Ray runtime with the framework pinned to it."""
+    monkeypatch.setenv("RLT_BACKEND", "ray")
+    from ray_lightning_tpu.cluster import backend as backend_mod
+    backend_mod.set_backend(None)
+    if not ray.is_initialized():
+        ray.init(num_cpus=4, include_dashboard=False,
+                 ignore_reinit_error=True)
+    yield
+    backend = backend_mod._backend
+    if backend is not None:
+        backend.shutdown()
+    backend_mod.set_backend(None)
+    ray.shutdown()
+
+
+def _fit(n_workers=2, callbacks=()):
+    module = BoringModel()
+    trainer = Trainer(
+        max_epochs=2, limit_train_batches=4, limit_val_batches=2,
+        num_sanity_val_steps=0, enable_checkpointing=False,
+        callbacks=list(callbacks),
+        plugins=[RayXlaPlugin(num_workers=n_workers, platform="cpu")],
+    )
+    trainer.fit(module)
+    return trainer, module
+
+
+def test_train_over_real_ray_actors(ray_backend_env, seed):
+    """End-to-end fit across 2 genuine Ray actors: runtime_env env-var
+    propagation, object-store payload fan-out, queue relay, weight
+    round-trip (the reference's core topology, test_ddp.py analog)."""
+    import numpy as np
+    trainer, module = _fit(n_workers=2)
+    assert "val_loss" in trainer.callback_metrics
+    vars_ = module._trained_variables
+    norm = sum(float(np.abs(np.asarray(v)).sum())
+               for v in _leaves(vars_["params"]))
+    assert norm > 0
+
+
+def _leaves(tree):
+    if isinstance(tree, dict):
+        for v in tree.values():
+            yield from _leaves(v)
+    else:
+        yield tree
+
+
+def test_tune_report_in_real_ray_tune_trial(ray_backend_env, tmp_path,
+                                            seed):
+    """TuneReportCheckpointCallback fires inside a genuine ray.tune.run
+    trial and the trial records metric + checkpoint (the done-bar for
+    VERDICT item 1; reference tune.py:130-134, :161-178)."""
+    from ray import tune as ray_tune
+
+    def train_fn(config):
+        module = BoringModel(lr=config["lr"])
+        trainer = Trainer(
+            max_epochs=2, limit_train_batches=4, limit_val_batches=2,
+            num_sanity_val_steps=0, enable_checkpointing=False,
+            callbacks=[rlt_tune.TuneReportCheckpointCallback(
+                on="validation_end")],
+        )
+        trainer.fit(module)
+
+    analysis = ray_tune.run(
+        train_fn,
+        config={"lr": 0.05},
+        num_samples=1,
+        resources_per_trial=rlt_tune.get_tune_resources(
+            num_workers=1).as_placement_group_factory(),
+        local_dir=str(tmp_path),
+        verbose=0,
+    )
+    (trial,) = analysis.trials
+    assert trial.status == "TERMINATED"
+    assert "val_loss" in trial.last_result
+    assert trial.last_result["training_iteration"] == 2
+    assert trial.checkpoint is not None
+
+
+def test_tune_grandchild_relay_in_real_trial(ray_backend_env, tmp_path,
+                                             seed):
+    """The §3.3 topology with everything real: a genuine Tune trial
+    whose training runs in grandchild Ray actors; the report rides
+    ray.util.queue to the trial driver where the real session lives."""
+    from ray import tune as ray_tune
+
+    def train_fn(config):
+        module = BoringModel(lr=config["lr"])
+        trainer = Trainer(
+            max_epochs=1, limit_train_batches=2, limit_val_batches=1,
+            num_sanity_val_steps=0, enable_checkpointing=False,
+            callbacks=[rlt_tune.TuneReportCallback(on="validation_end")],
+            plugins=[RayXlaPlugin(num_workers=2, platform="cpu")],
+        )
+        trainer.fit(module)
+
+    analysis = ray_tune.run(
+        train_fn,
+        config={"lr": 0.05},
+        num_samples=1,
+        resources_per_trial=rlt_tune.get_tune_resources(
+            num_workers=2).as_placement_group_factory(),
+        local_dir=str(tmp_path),
+        verbose=0,
+    )
+    (trial,) = analysis.trials
+    assert trial.status == "TERMINATED"
+    assert "val_loss" in trial.last_result
+
+
+@pytest.mark.skipif(
+    os.environ.get("RLT_RAY_CLIENT_SMOKE") != "1",
+    reason="needs a running ray head with a client server "
+           "(CI sets RLT_RAY_CLIENT_SMOKE=1 + RAY_ADDRESS=ray://...)")
+def test_ray_client_driving(monkeypatch, seed):
+    """Driver connects over Ray Client (pickle-on-gRPC) and trains on
+    the cluster — the reference's tests/test_client.py path.  The CI job
+    starts ``ray start --head`` and exports RAY_ADDRESS=ray://127.0.0.1:
+    10001 before running this test."""
+    assert os.environ.get("RAY_ADDRESS", "").startswith("ray://")
+    monkeypatch.setenv("RLT_BACKEND", "ray")
+    from ray_lightning_tpu.cluster import backend as backend_mod
+    backend_mod.set_backend(None)
+    try:
+        trainer, module = _fit(n_workers=2)
+        assert "val_loss" in trainer.callback_metrics
+    finally:
+        backend = backend_mod._backend
+        if backend is not None:
+            backend.shutdown()
+        backend_mod.set_backend(None)
+        ray.shutdown()
