@@ -43,4 +43,9 @@ class LocalPlugin(ExecutionPlugin):
         return trainer._run_stage(module, datamodule, stage, ckpt_path)
 
     def local_devices(self):
-        return self._devices
+        if self._devices is not None:
+            return self._devices
+        # inside a builtin-tune trial with a device lease, the mesh spans
+        # only the trial's partition (tune/runner.py device isolation)
+        from ray_lightning_tpu.tune.session import get_trial_devices
+        return get_trial_devices()

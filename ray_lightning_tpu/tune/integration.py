@@ -22,7 +22,6 @@ zero-arg callables that the driver's ``process_results`` loop executes
 from __future__ import annotations
 
 import logging
-import os
 import warnings
 from dataclasses import dataclass, field
 from typing import Optional, Sequence, Union
@@ -128,13 +127,16 @@ class _TuneCallbackBase(Callback):
     @staticmethod
     def _relay(payload) -> None:
         """Run ``payload`` where the trial session lives: enqueue to the
-        driver when inside an actor worker, else call directly."""
+        driver when inside an actor worker, else call directly — the
+        direct path resolves against the builtin runner's session OR a
+        real Ray Tune/Train session (tune/ray_bridge.py)."""
         try:
             get_session().put_queue(payload)
             return
         except ValueError:
             pass
-        if tune_session.in_session():
+        from ray_lightning_tpu.tune import ray_bridge
+        if tune_session.in_session() or ray_bridge.in_session():
             payload()
         else:
             _log.warning(
@@ -183,7 +185,9 @@ class TuneReportCallback(_TuneCallbackBase):
 
 
 class _ReportPayload:
-    """Picklable zero-arg callable executed on the trial driver."""
+    """Picklable zero-arg callable executed on the trial driver.  The
+    session lookup happens at CALL time, driver-side — builtin runner
+    session or real Ray Tune/Train session, whichever is live there."""
 
     def __init__(self, metrics: dict):
         self.metrics = metrics
@@ -193,8 +197,10 @@ class _ReportPayload:
 
 
 class _CheckpointPayload:
-    """Write checkpoint bytes into the trial's checkpoint dir, driver-side
-    (tune.py:161-167 analog: worker bytes → driver fsspec write)."""
+    """Write checkpoint bytes into the trial's checkpoint store,
+    driver-side (tune.py:161-167 analog: worker bytes → driver write —
+    a directory under classic Tune/builtin runner, a staged
+    report-attached checkpoint under the modern Ray Train API)."""
 
     def __init__(self, blob: bytes, step: int, filename: str):
         self.blob = blob
@@ -202,9 +208,7 @@ class _CheckpointPayload:
         self.filename = filename
 
     def __call__(self):
-        with tune_session.checkpoint_dir(self.step) as d:
-            with open(os.path.join(d, self.filename), "wb") as f:
-                f.write(self.blob)
+        tune_session.deliver_checkpoint(self.blob, self.step, self.filename)
 
 
 class _TuneCheckpointCallback(_TuneCallbackBase):

@@ -1,0 +1,159 @@
+"""Bridge to a *genuine* Ray Tune/Train trial session.
+
+The builtin runner (tune/runner.py) keeps its own thread-local session;
+but the reference's canonical recipe is ``ray.tune.run(train_fn,
+resources_per_trial=get_tune_resources(...))`` with real Ray Tune
+(reference README.md:140-183), where ``tune.report`` /
+``tune.checkpoint_dir`` resolve against Ray's own session living in the
+trial-driver process (reference tune.py:130-134, :161-178).  This module
+detects that session and routes our relay payloads into it, so the same
+``TuneReportCallback`` works under either runner.
+
+Two Ray API generations are supported, probed in order:
+
+- **classic function-trainable API** (the one the reference binds):
+  ``ray.tune.report(**metrics)`` and ``with ray.tune.checkpoint_dir(step)``.
+- **modern Train API** (ray >= 2.x): ``ray.train.report(metrics,
+  checkpoint=Checkpoint.from_directory(dir))`` — a checkpoint can only
+  ride a report, so checkpoint payloads are *staged* and attached to the
+  next report (the callbacks fire checkpoint-then-report in that order
+  precisely so this pairing works, reference tune.py:234-236).
+
+Everything is probed lazily and defensively: Ray absent, Ray present but
+no live session, and either API generation all behave sensibly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import shutil
+import tempfile
+import threading
+
+_log = logging.getLogger(__name__)
+
+# modern-API checkpoint staged for the next report, per trial thread
+_local = threading.local()
+
+
+# -- session detection ------------------------------------------------------
+
+def _classic_session_live() -> bool:
+    """True when ray.tune's classic function-trainable session exists."""
+    try:
+        from ray import tune
+    except Exception:
+        return False
+    for probe in ("is_session_enabled",):
+        fn = getattr(tune, probe, None)
+        if fn is not None:
+            try:
+                return bool(fn())
+            except Exception:
+                return False
+    # older layout: ray.tune.session.get_session()
+    try:
+        from ray.tune.session import get_session
+        return get_session() is not None
+    except Exception:
+        return False
+
+
+def _train_session():
+    """The modern Train-API session object, or None."""
+    try:
+        from ray.train._internal.session import get_session
+        return get_session()
+    except Exception:
+        return None
+
+
+def in_session() -> bool:
+    """True when a real Ray Tune/Train session is live in this process."""
+    return _classic_session_live() or _train_session() is not None
+
+
+# -- report -----------------------------------------------------------------
+
+def report(metrics: dict) -> bool:
+    """Deliver ``metrics`` to the live real-Ray session.
+
+    Returns False when no real session exists (caller falls through to
+    its own error/warning path).  A staged modern-API checkpoint is
+    attached and consumed.
+    """
+    if _classic_session_live():
+        from ray import tune
+        tune.report(**metrics)
+        return True
+    if _train_session() is not None:
+        from ray import train
+        staged = getattr(_local, "pending_checkpoint", None)
+        _local.pending_checkpoint = None
+        if staged is not None:
+            checkpoint = _as_train_checkpoint(staged)
+            try:
+                train.report(dict(metrics), checkpoint=checkpoint)
+            finally:
+                shutil.rmtree(staged, ignore_errors=True)
+        else:
+            train.report(dict(metrics))
+        return True
+    return False
+
+
+def _as_train_checkpoint(directory: str):
+    from ray.train import Checkpoint
+    return Checkpoint.from_directory(directory)
+
+
+# -- checkpoint -------------------------------------------------------------
+
+def stage_checkpoint(blob: bytes, step: int, filename: str) -> bool:
+    """Hand checkpoint bytes to the live real-Ray session.
+
+    Classic API: written straight into ``tune.checkpoint_dir(step)``
+    (the reference's exact move, tune.py:161-167).  Modern API: written
+    to a temp dir and staged; the next :func:`report` attaches it.
+    Returns False when no real session exists.
+    """
+    if _classic_session_live():
+        from ray import tune
+        with tune.checkpoint_dir(step=step) as d:
+            with open(os.path.join(d, filename), "wb") as f:
+                f.write(blob)
+        return True
+    if _train_session() is not None:
+        prev = getattr(_local, "pending_checkpoint", None)
+        if prev is not None:
+            # a checkpoint was staged but never reported (standalone
+            # checkpoint cadence): the newer one supersedes it.
+            _log.warning(
+                "Staged Tune checkpoint was replaced before any report "
+                "attached it; pair _TuneCheckpointCallback with a report "
+                "(TuneReportCheckpointCallback) under the modern Ray "
+                "Train API.")
+            shutil.rmtree(prev, ignore_errors=True)
+        d = tempfile.mkdtemp(prefix=f"rlt_tune_ckpt_{step}_")
+        with open(os.path.join(d, filename), "wb") as f:
+            f.write(blob)
+        _local.pending_checkpoint = d
+        return True
+    return False
+
+
+@contextlib.contextmanager
+def checkpoint_dir(step: int):
+    """Classic-API passthrough used when callers want a directory.  The
+    modern Train API has no standalone checkpoint directory — use
+    :func:`stage_checkpoint` + :func:`report` there."""
+    if not _classic_session_live():
+        raise RuntimeError(
+            "checkpoint_dir() requires the classic Ray Tune session; "
+            "under the modern Ray Train API checkpoints must be "
+            "attached to a report.")
+    from ray import tune
+    with tune.checkpoint_dir(step=step) as d:
+        yield d

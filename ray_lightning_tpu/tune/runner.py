@@ -110,6 +110,74 @@ def _accepts_checkpoint_dir(fn: Callable) -> bool:
         return False
 
 
+def _trial_device_demand(resources_per_trial: Any) -> Optional[int]:
+    """Chips one trial wants, from ``get_tune_resources(...)`` output
+    (TrialResources) or a plain ``{"TPU": n}`` dict.  None = no device
+    demand declared (CPU-only bundles)."""
+    if resources_per_trial is None:
+        return None
+    bundles = getattr(resources_per_trial, "bundles", None)
+    if bundles is not None:
+        demand = sum(int(b.get("TPU", 0)) for b in bundles)
+    elif isinstance(resources_per_trial, dict):
+        demand = int(resources_per_trial.get("TPU", 0))
+    else:
+        return None
+    return demand or None
+
+
+class _DeviceLeaser:
+    """Partitions the visible devices into disjoint per-trial chunks.
+
+    The reference gets trial isolation for free from Ray placement
+    groups (tune.py:50-56: bundles exist precisely so trials never share
+    devices); the local runner provides the same guarantee for
+    *in-process* (LocalPlugin) trials — a trial leases its chunk when
+    its Trainer first asks for devices and holds it for the trial's
+    lifetime (including PBT exploit restarts); trials wanting more
+    chips than remain simply wait, which serializes full-mesh trials.
+
+    Everything is lazy: ``jax`` is imported (and the backend
+    initialized) only inside a trial thread that actually trains
+    in-process.  Actor-based trials never acquire, so a CPU-only tune
+    driver stays free of any JAX backend and cluster-level chip demands
+    are left to the cluster backend — exactly the reference's split,
+    where placement groups size *cluster* resources and the trial
+    driver itself stays thin.
+    """
+
+    def __init__(self, per_trial: int):
+        self._per_trial = per_trial
+        self._chunks: Optional[list] = None
+        self._cond = threading.Condition()
+
+    def _ensure_chunks(self) -> None:
+        if self._chunks is not None:
+            return
+        import jax
+        devices = list(jax.devices())
+        if self._per_trial > len(devices):
+            raise ValueError(
+                f"resources_per_trial wants {self._per_trial} devices "
+                f"but only {len(devices)} are visible to this process")
+        self._chunks = [
+            devices[i:i + self._per_trial]
+            for i in range(0, len(devices) - self._per_trial + 1,
+                           self._per_trial)]
+
+    def acquire(self) -> list:
+        with self._cond:
+            self._ensure_chunks()
+            while not self._chunks:
+                self._cond.wait()
+            return self._chunks.pop()
+
+    def release(self, chunk: list) -> None:
+        with self._cond:
+            self._chunks.append(chunk)
+            self._cond.notify()
+
+
 def run(
     trainable: Callable,
     config: Optional[dict] = None,
@@ -119,8 +187,8 @@ def run(
     metric: Optional[str] = None,
     mode: Optional[str] = None,
     stop: Optional[dict] = None,
-    resources_per_trial: Any = None,   # accepted for parity; local runner
-    local_dir: Optional[str] = None,   # schedules by max_concurrent only
+    resources_per_trial: Any = None,
+    local_dir: Optional[str] = None,
     name: Optional[str] = None,
     max_concurrent_trials: Optional[int] = None,
     fail_fast: bool = False,
@@ -132,6 +200,19 @@ def run(
 
     ``trainable(config)`` or ``trainable(config, checkpoint_dir=None)``
     (the latter enables PBT exploit restores, reference-PBT contract).
+
+    Device isolation: when ``resources_per_trial`` declares a TPU chip
+    count (``get_tune_resources(...)`` bundles or ``{"TPU": n}``), the
+    visible devices are partitioned into disjoint n-chip leases that
+    *in-process* (LocalPlugin) trials acquire when their Trainer first
+    asks for devices — each such trial's mesh spans only its lease,
+    effective concurrency is ``len(devices) // n``, and trials wanting
+    the full mesh serialize.  Trials whose compute runs in actor
+    subprocesses never acquire a lease (their chip demand is a cluster
+    resource, the backend's job), so the tune driver itself never
+    initializes a JAX backend.  Without a declared chip count,
+    concurrent in-process trials share every visible device — declare
+    resources to isolate them.
     """
     scheduler = scheduler or FIFOScheduler(metric or "loss", mode or "min")
     # metric/mode default from the scheduler as one unit, so analysis
@@ -164,6 +245,8 @@ def run(
         max_concurrent_trials = (
             len(trials) if isinstance(scheduler, PopulationBasedTraining)
             else 1)
+    demand = _trial_device_demand(resources_per_trial)
+    leaser = _DeviceLeaser(demand) if demand is not None else None
     sem = threading.Semaphore(max(1, max_concurrent_trials))
 
     def on_report(trial: Trial, metrics: dict) -> None:
@@ -189,7 +272,7 @@ def run(
             if abort.is_set():
                 return  # fail_fast tripped; leave trial PENDING
             trial.status = "RUNNING"
-            session = TrialSession(trial, on_report)
+            session = TrialSession(trial, on_report, device_leaser=leaser)
             set_session(session)
             restore_from: Optional[str] = None
             try:
@@ -227,6 +310,7 @@ def run(
             finally:
                 scheduler.on_trial_complete(trial)
                 set_session(None)
+                session.release_devices()
 
     threads = [threading.Thread(target=run_trial, args=(t,), daemon=True)
                for t in trials]

@@ -21,12 +21,32 @@ _local = threading.local()
 
 
 class TrialSession:
-    """Live context of one running trial."""
+    """Live context of one running trial.
 
-    def __init__(self, trial, on_report):
+    ``devices`` is the trial's leased device subset, acquired LAZILY the
+    first time in-process training asks for devices (tune/runner.py
+    ``_DeviceLeaser``) — trials whose compute lives in actor
+    subprocesses never acquire, so the tune driver never initializes a
+    JAX backend for them.  None = no lease, the trial may span every
+    visible device.
+    """
+
+    def __init__(self, trial, on_report, device_leaser=None):
         self.trial = trial
         self._on_report = on_report
         self._step = 0
+        self._leaser = device_leaser
+        self.devices = None
+
+    def acquire_devices(self):
+        if self._leaser is not None and self.devices is None:
+            self.devices = self._leaser.acquire()
+        return self.devices
+
+    def release_devices(self) -> None:
+        if self._leaser is not None and self.devices is not None:
+            self._leaser.release(self.devices)
+            self.devices = None
 
     def report(self, **metrics) -> None:
         self._step += 1
@@ -58,24 +78,67 @@ def in_session() -> bool:
 
 
 def report(_metrics: Optional[dict] = None, **metrics) -> None:
-    """Report metrics for the current trial (``tune.report`` analog)."""
-    s = _get()
-    if s is None:
-        raise RuntimeError(
-            "tune.report() called outside a tune trial; run this function "
-            "via ray_lightning_tpu.tune.run().")
+    """Report metrics for the current trial (``tune.report`` analog).
+
+    Resolves against the builtin runner's session when one is live,
+    falling back to a *real* Ray Tune/Train session (tune/ray_bridge.py)
+    — so a train_fn written against this API runs unchanged under
+    genuine ``ray.tune.run``.
+    """
     merged = dict(_metrics or {})
     merged.update(metrics)
-    s.report(**merged)
+    s = _get()
+    if s is not None:
+        s.report(**merged)
+        return
+    from ray_lightning_tpu.tune import ray_bridge
+    if ray_bridge.report(merged):
+        return
+    raise RuntimeError(
+        "tune.report() called outside a tune trial; run this function "
+        "via ray_lightning_tpu.tune.run() or a real Ray Tune trial.")
+
+
+def deliver_checkpoint(blob: bytes, step: int, filename: str) -> None:
+    """Write checkpoint bytes where the live trial session keeps
+    checkpoints — builtin runner's trial dir, classic Ray Tune's
+    ``checkpoint_dir``, or staged for the modern Train API's next
+    report (reference analog: tune.py:161-167)."""
+    s = _get()
+    if s is not None:
+        with s.checkpoint_dir(step) as d:
+            with open(os.path.join(d, filename), "wb") as f:
+                f.write(blob)
+        return
+    from ray_lightning_tpu.tune import ray_bridge
+    if ray_bridge.stage_checkpoint(blob, step, filename):
+        return
+    raise RuntimeError(
+        "Tune checkpoint relay outside a tune trial; run via "
+        "ray_lightning_tpu.tune.run() or a real Ray Tune trial.")
 
 
 @contextlib.contextmanager
 def checkpoint_dir(step: int):
     s = _get()
     if s is None:
+        from ray_lightning_tpu.tune import ray_bridge
+        if ray_bridge.in_session():
+            with ray_bridge.checkpoint_dir(step) as path:
+                yield path
+            return
         raise RuntimeError("tune.checkpoint_dir() outside a tune trial.")
     with s.checkpoint_dir(step) as path:
         yield path
+
+
+def get_trial_devices():
+    """Devices leased to the current trial, or None (no trial / no
+    lease declared).  LocalPlugin consults this so an in-process
+    trial's mesh spans only its own partition of the host's chips; the
+    lease is acquired on first call (may block until a chunk frees)."""
+    s = _get()
+    return s.acquire_devices() if s is not None else None
 
 
 def get_trial_id() -> str:
