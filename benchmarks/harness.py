@@ -24,27 +24,46 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
     from ray_lightning_tpu.core.callbacks import Callback
 
     class Timer(Callback):
+        """>=` comparisons + actual step counting so chunked dispatch
+        (steps_per_execution>1: global_step advances k at a time) is
+        timed correctly."""
+
         def __init__(self):
             self.t0 = None
+            self.start_step = None
+            self.steps = None
             self.elapsed = None
 
+        @staticmethod
+        def _sync(metrics):
+            # fetch a loss value: the only reliable device sync point on
+            # remote-tunnel platforms
+            float(np.asarray(metrics["loss"]).ravel()[-1])
+
         def on_train_batch_end(self, trainer, mod, metrics, batch, idx):
-            if trainer.global_step == warmup:
-                float(np.asarray(metrics["loss"]))
+            if self.t0 is None and trainer.global_step >= warmup:
+                self._sync(metrics)
+                self.start_step = trainer.global_step
                 self.t0 = time.monotonic()
-            elif trainer.global_step == warmup + timed:
-                float(np.asarray(metrics["loss"]))
+            elif self.t0 is not None and self.elapsed is None \
+                    and trainer.global_step >= self.start_step + timed:
+                self._sync(metrics)
                 self.elapsed = time.monotonic() - self.t0
+                self.steps = trainer.global_step - self.start_step
 
     timer = Timer()
+    # chunked dispatch rounds the warmup boundary up to a chunk edge, so
+    # leave 2 chunks of slack past warmup+timed
+    slack = 2 * (trainer_kwargs or {}).get("steps_per_execution", 1)
     trainer = Trainer(
-        max_steps=warmup + timed, max_epochs=10**6, strategy=strategy,
+        max_steps=warmup + timed + slack, max_epochs=10**6,
+        strategy=strategy,
         enable_checkpointing=False, num_sanity_val_steps=0,
         limit_val_batches=0, log_every_n_steps=10**9, callbacks=[timer],
         seed=0, **(trainer_kwargs or {}))
     trainer.fit(module)
     assert timer.elapsed is not None, "did not reach timed steps"
-    steps_per_sec = timed / timer.elapsed
+    steps_per_sec = timer.steps / timer.elapsed
     result = {
         "metric": metric,
         "value": round(steps_per_sec, 3),

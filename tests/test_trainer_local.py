@@ -111,6 +111,102 @@ def test_max_steps(tmp_path, seed):
     assert trainer.global_step == 5
 
 
+def test_steps_per_execution_matches_per_step(tmp_path, seed):
+    """k steps folded into one compiled scan must train identically to k
+    sequential dispatches: same final weights, same step count (the
+    learning-curve guarantee for VERDICT item 3)."""
+    from ray_lightning_tpu.parallel.gather import fetch_tree
+
+    def run(k):
+        trainer = get_trainer(str(tmp_path), max_epochs=1,
+                              limit_train_batches=16,
+                              steps_per_execution=k)
+        module = BoringModel(batch_size=8, lr=0.05, dataset_length=128)
+        trainer.fit(module)
+        return trainer, fetch_tree(trainer.state.params)
+
+    t1, p1 = run(1)
+    t4, p4 = run(4)
+    assert t1.global_step == t4.global_step == 16
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p4)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    # epoch-mean metrics survive the mixed scalar/[k] accumulator
+    assert np.isfinite(t4.callback_metrics["loss"])
+
+
+def test_cache_train_dataset_matches_streamed(tmp_path, seed):
+    """Device-resident dataset + on-device index gather must train
+    identically to streamed batches for epoch 0 (same order)."""
+    from ray_lightning_tpu.parallel.gather import fetch_tree
+
+    def run(**kw):
+        trainer = get_trainer(str(tmp_path), max_epochs=1,
+                              limit_train_batches=16, **kw)
+        module = BoringModel(batch_size=8, lr=0.05, dataset_length=128)
+        trainer.fit(module)
+        return trainer, fetch_tree(trainer.state.params)
+
+    t_stream, p_stream = run()
+    t_cached, p_cached = run(steps_per_execution=4,
+                             cache_train_dataset=True)
+    assert t_stream.global_step == t_cached.global_step == 16
+    for a, b in zip(jax.tree_util.tree_leaves(p_stream),
+                    jax.tree_util.tree_leaves(p_cached)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_cache_train_dataset_multi_epoch_learns(tmp_path, seed):
+    """Across epochs the cached path reshuffles batch order and keeps
+    training (loss shrinks); step accounting stays exact."""
+    trainer = get_trainer(str(tmp_path), max_epochs=3,
+                          limit_train_batches=16,
+                          steps_per_execution=4, cache_train_dataset=True)
+    module = BoringModel(batch_size=8, lr=0.05, dataset_length=128)
+    trainer.fit(module)
+    assert trainer.global_step == 48
+    assert trainer.callback_metrics["loss"] < 1.0
+
+
+def test_cache_train_dataset_respects_max_steps(tmp_path, seed):
+    trainer = get_trainer(str(tmp_path), max_epochs=10, max_steps=6,
+                          steps_per_execution=4, cache_train_dataset=True)
+    trainer.fit(BoringModel(batch_size=8, dataset_length=128))
+    assert trainer.global_step == 6
+
+
+def test_steps_per_execution_respects_max_steps(tmp_path, seed):
+    """A chunk never overshoots max_steps: 6 = one 4-chunk + 2 single
+    tail steps, no recompile for the ragged tail."""
+    trainer = get_trainer(str(tmp_path), max_epochs=10, max_steps=6,
+                          steps_per_execution=4)
+    trainer.fit(BoringModel(batch_size=8))
+    assert trainer.global_step == 6
+
+
+def test_steps_per_execution_val_interval_boundary(tmp_path, seed):
+    """Chunks clamp to val_check_interval so mid-epoch validation still
+    happens on schedule."""
+    evals = []
+
+    class CountVal(EarlyStopping):
+        def __init__(self):
+            super().__init__(monitor="val_loss", patience=10**6)
+
+        def on_validation_end(self, trainer, module):
+            evals.append(trainer.global_step)
+            super().on_validation_end(trainer, module)
+
+    trainer = get_trainer(str(tmp_path), max_epochs=1,
+                          limit_train_batches=12, val_check_interval=3,
+                          steps_per_execution=8,
+                          callbacks=[CountVal()])
+    trainer.fit(BoringModel(batch_size=8, dataset_length=128))
+    assert evals[:4] == [3, 6, 9, 12]
+
+
 def test_gradient_accumulation(tmp_path, seed):
     trainer = get_trainer(str(tmp_path), accumulate_grad_batches=2)
     module = BoringModel(batch_size=4)
