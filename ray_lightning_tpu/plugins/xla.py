@@ -250,11 +250,13 @@ class RayXlaPlugin(ExecutionPlugin):
         node_info = process_results(
             [w.call("get_node_and_device_info") for w in workers], backend)
         ranks = self._assign_local_ranks(node_info)
+        tpu_env = self._tpu_partition_envs(node_info, ranks, backend)
         env_futs = []
         for i, w in enumerate(workers):
             node_rank, local_rank = ranks[i]
             env_futs.append(w.call("set_env_vars", {
                 **coord_env,
+                **tpu_env.get(i, {}),
                 "RLT_PROCESS_ID": str(i),
                 "RLT_NODE_RANK": str(node_rank),
                 "RLT_LOCAL_RANK": str(local_rank),
@@ -283,6 +285,36 @@ class RayXlaPlugin(ExecutionPlugin):
             if payload_ref is not None:
                 backend.free(payload_ref)
         return self._post_dispatch(trainer, module, stage, results)
+
+    def _tpu_partition_envs(self, node_info, ranks, backend) -> dict[int, dict]:
+        """Per-worker TPU chip-visibility env for co-located actors
+        (``_share_cuda_visible_devices`` analog, ray_ddp.py:221-265).
+
+        Whenever several TPU workers share one node IP, each gets a
+        ``TPU_*`` partition of that host's chips (utils/tpu_topology.py);
+        impossible splits raise before any worker touches libtpu.  A
+        worker alone on its host owns every chip and needs nothing.
+        """
+        if not self.use_tpu:
+            return {}
+        by_node: dict[int, list[int]] = {}
+        for i in range(len(node_info)):
+            node_rank, _local = ranks[i]
+            by_node.setdefault(node_rank, []).append(i)
+        out: dict[int, dict] = {}
+        d = int(self.devices_per_worker or 1)
+        from ray_lightning_tpu.utils.tpu_topology import partition_env
+        for members in by_node.values():
+            if len(members) < 2:
+                continue  # sole owner of the host: no scoping needed
+            members = sorted(members, key=lambda i: ranks[i][1])
+            ports = process_results(
+                [self._workers[i].call("get_free_port") for i in members],
+                backend)
+            ip = node_info[members[0]].get("ip", "?")
+            for i in members:
+                out[i] = partition_env(d, ranks[i][1], ip, ports)
+        return out
 
     @staticmethod
     def _assign_local_ranks(node_info: list[dict]) -> dict[int, tuple[int, int]]:
