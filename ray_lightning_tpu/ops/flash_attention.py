@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -356,10 +357,16 @@ def flash_attention(q, k, v, *, causal: bool = True, dtype=jnp.bfloat16,
     parallelism.
     """
     b, t, h, d = q.shape
+    # RLT_FLASH_BLOCK_Q/K override the heuristic (the sweep knob used to
+    # tune per-shape defaults; also a user escape hatch)
     if block_q is None:
-        block_q = t if t <= 1024 else 512
+        env_q = os.environ.get("RLT_FLASH_BLOCK_Q")
+        block_q = int(env_q) if env_q else (t if t <= 1024 else 512)
     if block_k is None:
-        block_k = t if t <= 1024 else 512
+        env_k = os.environ.get("RLT_FLASH_BLOCK_K")
+        block_k = int(env_k) if env_k else (t if t <= 1024 else 512)
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     if interpret is None:
