@@ -73,6 +73,18 @@ def test_load(tmp_path, seed, num_workers):
     load_test(trainer, BoringModel())
 
 
+def test_train_chunked_dispatch_across_actors(tmp_path, seed):
+    """steps_per_execution under a multi-process mesh: the stacked batch
+    rides make_array_from_process_local_data with leading-axis-replicated
+    shardings inside each worker — the in_shardings path the local tests
+    can't reach."""
+    trainer = get_trainer(str(tmp_path), plugins=[cpu_plugin(2)],
+                          max_epochs=1, limit_train_batches=8,
+                          checkpoint=False, steps_per_execution=4)
+    train_test(trainer, BoringModel(batch_size=8, dataset_length=128))
+    assert trainer.global_step == 8
+
+
 @pytest.mark.slow
 def test_predict(tmp_path, seed):
     trainer = get_trainer(str(tmp_path), max_epochs=4,
