@@ -64,6 +64,8 @@ class ThroughputMonitor(Callback):
         self._epoch_t0: Optional[float] = None
         self._units = 0
         self._samples = 0
+        self._steps = 0           # optimizer steps in the current window
+        self._prev_step = 0       # last observed trainer.global_step
 
     @staticmethod
     def _sync(outputs) -> None:
@@ -74,43 +76,57 @@ class ThroughputMonitor(Callback):
         if leaves:
             jax.block_until_ready(leaves[-1])
 
+    def _reset_window(self, trainer) -> None:
+        self._t0 = None
+        self._units = 0
+        self._samples = 0
+        self._steps = 0
+        self._prev_step = trainer.global_step
+
     def on_train_epoch_start(self, trainer, module):
         self._epoch_t0 = time.monotonic()
+        self._prev_step = trainer.global_step
 
     def on_validation_start(self, trainer, module):
         # mid-epoch eval does host+device work outside training; drop the
         # current window so it cannot deflate steps/sec
-        self._t0 = None
-        self._units = 0
-        self._samples = 0
+        self._reset_window(trainer)
 
     def on_train_batch_end(self, trainer, module, outputs, batch, batch_idx):
         import jax
+        # under steps_per_execution>1 this hook fires once per CHUNK with
+        # its last batch: count real optimizer steps by global_step delta
+        # and scale the sample/token tally by it (uniform batch shapes —
+        # the compiled multi-step requires them anyway)
+        delta = max(1, trainer.global_step - self._prev_step)
+        self._prev_step = trainer.global_step
+        self._steps += delta
         leaves = [x for x in jax.tree_util.tree_leaves(batch)
                   if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1]
         if leaves:
             lead = leaves[0]
-            self._samples += int(lead.shape[0])
+            self._samples += int(lead.shape[0]) * delta
             # tokens/sec only for [B, T] integer batches (token ids);
             # float [B, features...] batches are not sequences
             is_tokens = (self.log_tokens and lead.ndim == 2
                          and np.issubdtype(np.asarray(lead).dtype,
                                            np.integer))
-            self._units += int(lead.shape[0]) * (
+            self._units += int(lead.shape[0]) * delta * (
                 int(lead.shape[1]) if is_tokens else 1)
-        if trainer.global_step % self.window:
+        if self._steps < self.window:
             return
         self._sync(outputs)
         now = time.monotonic()
         if self._t0 is not None:
             dt = now - self._t0
-            trainer.log_metric("steps_per_sec", self.window / dt)
+            trainer.log_metric("steps_per_sec", self._steps / dt)
             trainer.log_metric("samples_per_sec", self._samples / dt)
             if self.log_tokens and self._units != self._samples:
                 trainer.log_metric("tokens_per_sec", self._units / dt)
         self._t0 = now
         self._units = 0
         self._samples = 0
+        self._steps = 0
 
     def on_train_epoch_end(self, trainer, module):
         if self._epoch_t0 is not None:
@@ -120,9 +136,7 @@ class ThroughputMonitor(Callback):
         if peak:
             trainer.log_metric("peak_memory_mb", peak / 1e6)
         # new window per epoch: the epoch boundary does host work
-        self._t0 = None
-        self._units = 0
-        self._samples = 0
+        self._reset_window(trainer)
 
 
 class JaxProfilerCallback(Callback):

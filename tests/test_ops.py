@@ -31,6 +31,32 @@ def test_flash_forward_matches_naive(causal, t):
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+def test_tri_decode_exact_for_all_indices():
+    """The triangular-grid decode must be EXACT on every backend: the
+    float sqrt is only an estimate (TPU's sqrt misrounds, e.g. i=6 →
+    2.99999976) and the integer correction must land every index on the
+    true (qi, kb) pair — a misdecode silently corrupts causal attention
+    at T>=2048 where the tri path is default-on."""
+    from ray_lightning_tpu.ops.flash_attention import (_tri_decode,
+                                                       _tri_decode_rev)
+    n = 64                                   # up to 64x64 block grids
+    idx = jnp.arange(n * (n + 1) // 2)
+    qi, kb = jax.jit(_tri_decode)(idx)
+    expect = [(q, c) for q in range(n) for c in range(q + 1)]
+    np.testing.assert_array_equal(np.asarray(qi), [e[0] for e in expect])
+    np.testing.assert_array_equal(np.asarray(kb), [e[1] for e in expect])
+
+    ki, qi2 = jax.jit(lambda i: _tri_decode_rev(i, n))(idx)
+    # every (ki, qi2) pair covers the qi>=ki triangle exactly once,
+    # contiguously per ki group, qi descending from n-1
+    seen = list(zip(np.asarray(ki).tolist(), np.asarray(qi2).tolist()))
+    assert sorted(seen) == sorted(
+        (k, q) for k in range(n) for q in range(k, n))
+    for a, b in zip(seen, seen[1:]):
+        assert (b[0] == a[0] and b[1] == a[1] - 1) or \
+            (b[0] == a[0] - 1 and b[1] == n - 1)
+
+
 def test_flash_uneven_blocks():
     # T=96 forces the block picker to halve down to a divisor
     q, k, v = _rand_qkv(t=96)

@@ -39,6 +39,37 @@ def test_throughput_monitor_tokens_for_sequences(tmp_path, seed):
     assert cbm["tokens_per_sec"] > cbm["samples_per_sec"]
 
 
+def test_throughput_monitor_with_chunked_dispatch(tmp_path, seed):
+    """steps_per_execution>1 advances global_step k at a time and fires
+    batch_end once per chunk: the monitor must still measure (delta
+    tracking — a modulo window check would never trigger when k does
+    not divide the window) and count samples for EVERY step of the
+    chunk, not just the callback's batch."""
+    ratios = []
+
+    class Capture(ThroughputMonitor):
+        def on_train_batch_end(self, trainer, module, outputs, batch,
+                               idx):
+            super().on_train_batch_end(trainer, module, outputs, batch,
+                                       idx)
+            cbm = trainer.callback_metrics
+            if "samples_per_sec" in cbm:
+                ratios.append(cbm["samples_per_sec"]
+                              / cbm["steps_per_sec"])
+
+    trainer = Trainer(max_epochs=1, limit_train_batches=15,
+                      limit_val_batches=0, num_sanity_val_steps=0,
+                      enable_checkpointing=False, seed=0,
+                      default_root_dir=str(tmp_path),
+                      steps_per_execution=5,
+                      callbacks=[Capture(window=4)])
+    trainer.fit(BoringModel(dataset_length=64, batch_size=4))
+    assert trainer.callback_metrics["steps_per_sec"] > 0
+    # samples/sec must equal batch_size x steps/sec — i.e. every step of
+    # each 5-step chunk was counted, not just the last one
+    assert ratios and all(abs(r - 4.0) < 1e-6 for r in ratios)
+
+
 def test_profiler_callback_writes_trace(tmp_path, seed):
     prof_dir = str(tmp_path / "prof")
     trainer = Trainer(max_epochs=1, limit_train_batches=6,
