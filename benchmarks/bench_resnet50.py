@@ -23,6 +23,17 @@ def main():
     run_steps_per_sec(module, f"{cfg}_b{batch}_steps_per_sec_{platform}",
                       baseline=BASELINES.get(platform))
 
+    # image batches are ~1.6 MB: on a tunneled chip the host link (not
+    # compute) can bound the streamed number, so also measure with the
+    # train set resident on device — the tunnel-independent figure
+    module = ResNetLightningModule(cfg, batch_size=batch,
+                                   train_size=batch * 40)
+    run_steps_per_sec(
+        module, f"{cfg}_b{batch}_cached_steps_per_sec_{platform}",
+        timed=120, baseline=BASELINES.get(platform),
+        trainer_kwargs={"steps_per_execution": 8,
+                        "cache_train_dataset": True})
+
 
 if __name__ == "__main__":
     main()

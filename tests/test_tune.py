@@ -271,6 +271,47 @@ def test_trial_demand_exceeding_devices_errors(tmp_path, seed):
     assert "only 8 are visible" in trial.error
 
 
+@pytest.mark.slow
+def test_pbt_population_with_device_leases(tmp_path, seed):
+    """BASELINE config #3 shape on the virtual mesh: a PBT population
+    of 4 concurrent MNIST trials, each training on its own disjoint
+    2-chip lease of the 8-device mesh."""
+    import threading
+
+    leases = {}
+    barrier = threading.Barrier(4, timeout=120)
+
+    def fn(config, checkpoint_dir=None):
+        module = LightningMNISTClassifier(
+            config={"batch_size": 16, "lr": config["lr"]}, train_size=64)
+        trainer = Trainer(
+            max_epochs=2, limit_train_batches=2, limit_val_batches=1,
+            num_sanity_val_steps=0, enable_checkpointing=False,
+            callbacks=[tune.TuneReportCallback(on="validation_end")],
+        )
+        trainer.fit(module)
+        leases[config["lr"]] = frozenset(
+            d.id for d in trainer._mesh.devices.flat)
+        barrier.wait()   # the whole population held leases concurrently
+
+    analysis = tune.run(
+        fn,
+        config={"lr": tune.grid_search([0.05, 0.02, 0.01, 0.005])},
+        resources_per_trial=tune.get_tune_resources(
+            num_workers=1, use_tpu=True, tpus_per_worker=2),
+        scheduler=tune.PopulationBasedTraining(
+            metric="ptl/val_accuracy", mode="max",
+            perturbation_interval=10**6,   # population runs, no exploit
+            hyperparam_mutations={"lr": [0.05, 0.01]}),
+        local_dir=str(tmp_path))
+    assert len(leases) == 4
+    assert all(t.status == "TERMINATED" for t in analysis.trials)
+    sets = list(leases.values())
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert sets[i].isdisjoint(sets[j])
+
+
 def test_report_outside_trial_raises():
     with pytest.raises(RuntimeError):
         tune.report(loss=1.0)
