@@ -30,6 +30,42 @@ def _fit(strategy=None, **kw):
 
 
 @pytest.mark.parametrize("strategy", ["ddp", "zero1", "fsdp"])
+def test_chunked_dispatch_with_strategies(strategy, seed):
+    """steps_per_execution composes with every sharding strategy (the
+    multi-step scan carries the sharded TrainState through its body)."""
+    t = _fit(strategy=strategy, steps_per_execution=2,
+             module=BoringModel(batch_size=8, dataset_length=64))
+    assert t.global_step == 4
+
+
+@pytest.mark.parametrize("strategy", ["ddp", "zero1", "fsdp"])
+def test_dataset_cache_with_strategies(strategy, seed):
+    """cache_train_dataset composes with sharded state: the on-device
+    gather feeds a batch into the same sharded step."""
+    t = _fit(strategy=strategy, steps_per_execution=2,
+             cache_train_dataset=True,
+             module=BoringModel(batch_size=8, dataset_length=64))
+    assert t.global_step == 4
+
+
+def test_chunked_dispatch_with_accumulation(seed):
+    """steps_per_execution (outer scan) and accumulate_grad_batches
+    (inner scan) nest: 4 loader batches = 2 chunks x (2 micro-steps)."""
+    t = _fit(steps_per_execution=2, accumulate_grad_batches=2,
+             module=BoringModel(batch_size=8, dataset_length=64))
+    assert t.global_step == 4
+
+
+def test_cache_with_bf16_precision(seed):
+    """The cached dataset is stored in the cast dtype, so bf16 input
+    precision composes with on-device gathering."""
+    t = _fit(precision="bf16", steps_per_execution=2,
+             cache_train_dataset=True,
+             module=BoringModel(batch_size=8, dataset_length=64))
+    assert t.global_step == 4
+
+
+@pytest.mark.parametrize("strategy", ["ddp", "zero1", "fsdp"])
 def test_grad_accumulation_with_strategies(strategy, seed):
     t = _fit(strategy=strategy, accumulate_grad_batches=2)
     assert t.global_step == 4

@@ -168,3 +168,21 @@ def test_classic_session_through_actor_queue(classic_session, seed,
     assert len(classic_session["reports"]) == 2
     for r in classic_session["reports"]:
         assert "val_loss" in r
+
+
+@pytest.mark.slow
+def test_classic_checkpoint_through_actor_queue(classic_session, seed,
+                                                monkeypatch):
+    """Checkpoint bytes assembled on remote rank 0 ride the queue and
+    land in the (stubbed) genuine ray.tune checkpoint_dir, checkpoint
+    before report (reference tune.py:161-178, :234-236)."""
+    monkeypatch.setenv("RLT_BACKEND", "local")
+    from ray_lightning_tpu import RayXlaPlugin
+
+    _fit(tune.TuneReportCheckpointCallback(on="validation_end"),
+         plugins=[RayXlaPlugin(num_workers=2, platform="cpu")])
+    assert len(classic_session["reports"]) == 2
+    assert len(classic_session["ckpt_dirs"]) == 2
+    path = os.path.join(classic_session["ckpt_dirs"][-1], "checkpoint")
+    ckpt = Trainer.load_checkpoint_dict(path)
+    assert ckpt["global_step"] > 0 and "state" in ckpt
