@@ -177,6 +177,22 @@ def test_cache_train_dataset_respects_max_steps(tmp_path, seed):
     assert trainer.global_step == 6
 
 
+def test_chunked_limit_counts_loader_positions(tmp_path, seed):
+    """limit_train_batches counts loader positions in BOTH dispatch
+    paths: with a short (skipped) final batch in the stream, k=1 and
+    k=4 must run the same step count (review regression guard)."""
+    # 68 rows / batch 8 -> 8 full batches + one short batch of 4 that
+    # _batch_ok skips on the 8-shard mesh
+    def run(k):
+        trainer = get_trainer(str(tmp_path), max_epochs=1,
+                              limit_train_batches=9, checkpoint=False,
+                              steps_per_execution=k)
+        trainer.fit(BoringModel(batch_size=8, dataset_length=68))
+        return trainer.global_step
+
+    assert run(1) == run(4) == 8
+
+
 def test_steps_per_execution_respects_max_steps(tmp_path, seed):
     """A chunk never overshoots max_steps: 6 = one 4-chunk + 2 single
     tail steps, no recompile for the ragged tail."""
