@@ -191,6 +191,7 @@ def run(
     local_dir: Optional[str] = None,
     name: Optional[str] = None,
     max_concurrent_trials: Optional[int] = None,
+    max_failures: int = 0,
     fail_fast: bool = False,
     raise_on_failed_trial: bool = True,
     seed: int = 0,
@@ -199,7 +200,14 @@ def run(
     """Run ``num_samples`` trials of ``trainable`` over ``config``.
 
     ``trainable(config)`` or ``trainable(config, checkpoint_dir=None)``
-    (the latter enables PBT exploit restores, reference-PBT contract).
+    (the latter enables PBT exploit restores and checkpoint-resumed
+    trial retries, reference-PBT/Tune contract).
+
+    ``max_failures``: retry a crashed trial up to this many times
+    (``ray.tune`` ``max_failures`` parity — the reference's recovery
+    story is exactly "Tune trial retries + checkpoints", SURVEY.md §5);
+    a trainable with a ``checkpoint_dir`` parameter resumes from the
+    trial's latest checkpoint.
 
     Device isolation: when ``resources_per_trial`` declares a TPU chip
     count (``get_tune_resources(...)`` bundles or ``{"TPU": n}``), the
@@ -275,6 +283,7 @@ def run(
             session = TrialSession(trial, on_report, device_leaser=leaser)
             set_session(session)
             restore_from: Optional[str] = None
+            failures = 0
             try:
                 while True:
                     try:
@@ -295,9 +304,34 @@ def run(
                                 "checkpoint_dir parameter; continuing "
                                 "without restore.", trainable)
                         restore_from = e.checkpoint
+                        # the donor checkpoint is now this trial's
+                        # restore source: a crash-retry after the
+                        # exploit must resume the exploited weights,
+                        # not the trial's stale pre-exploit checkpoint
+                        trial.latest_checkpoint = e.checkpoint
                         _log.info("%s exploiting: restart from %s",
                                   trial.trial_id, e.checkpoint)
                         continue  # restart with mutated config
+                    except Exception:
+                        # trial retry — the reference's ONLY recovery
+                        # story (SURVEY.md §5 failure detection: "Tune
+                        # trial retries + checkpoints"): restart the
+                        # trainable, resuming from its latest checkpoint
+                        # when it takes one.  Exception only: SystemExit
+                        # / KeyboardInterrupt are deliberate exits, not
+                        # retryable crashes (ray.tune parity) — the
+                        # outer handler records them once.
+                        failures += 1
+                        if failures > max_failures or abort.is_set():
+                            raise
+                        restore_from = (trial.latest_checkpoint
+                                        if takes_ckpt else None)
+                        _log.warning(
+                            "%s failed (attempt %d/%d); retrying%s:\n%s",
+                            trial.trial_id, failures, max_failures + 1,
+                            f" from {restore_from}" if restore_from
+                            else "", traceback.format_exc())
+                        continue
             except BaseException as e:          # noqa: BLE001
                 trial.status = "ERROR"
                 trial.error = traceback.format_exc()

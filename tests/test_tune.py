@@ -312,6 +312,89 @@ def test_pbt_population_with_device_leases(tmp_path, seed):
             assert sets[i].isdisjoint(sets[j])
 
 
+def test_trial_retry_on_failure(tmp_path, seed):
+    """max_failures retries a crashed trial (the reference's recovery
+    story: Tune trial retries, SURVEY.md §5)."""
+    attempts = {"n": 0}
+
+    def fn(config):
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            raise RuntimeError("flaky init")
+        tune.report(loss=1.0)
+
+    analysis = tune.run(fn, config={}, max_failures=1,
+                        metric="loss", mode="min",
+                        local_dir=str(tmp_path))
+    (trial,) = analysis.trials
+    assert trial.status == "TERMINATED"
+    assert attempts["n"] == 2
+    assert trial.last_result["loss"] == 1.0
+
+
+def test_trial_retry_resumes_from_checkpoint(tmp_path, seed):
+    """A retried checkpoint-taking trainable resumes from the trial's
+    latest checkpoint instead of restarting cold."""
+    attempts = {"n": 0}
+
+    def fn(config, checkpoint_dir=None):
+        attempts["n"] += 1
+        start = 0
+        if checkpoint_dir:
+            with open(os.path.join(checkpoint_dir, "v.txt")) as f:
+                start = int(f.read())
+        for step in range(start + 1, 7):
+            with tune.checkpoint_dir(step) as d:
+                with open(os.path.join(d, "v.txt"), "w") as f:
+                    f.write(str(step))
+            tune.report(progress=step)
+            if attempts["n"] == 1 and step == 3:
+                raise RuntimeError("mid-training crash")
+
+    analysis = tune.run(fn, config={}, max_failures=2,
+                        metric="progress", mode="max",
+                        local_dir=str(tmp_path))
+    (trial,) = analysis.trials
+    assert trial.status == "TERMINATED"
+    assert attempts["n"] == 2
+    # resumed at 3, not 0: steps 4..6 ran exactly once
+    assert trial.last_result["progress"] == 6
+
+
+def test_trial_retry_skips_deliberate_exits(tmp_path, seed):
+    """SystemExit is a deliberate bail-out, not a retryable crash
+    (ray.tune parity): one attempt, trial ERROR."""
+    attempts = {"n": 0}
+
+    def fn(config):
+        attempts["n"] += 1
+        raise SystemExit(1)
+
+    analysis = tune.run(fn, config={}, max_failures=3,
+                        metric="loss", mode="min",
+                        local_dir=str(tmp_path),
+                        raise_on_failed_trial=False)
+    assert analysis.trials[0].status == "ERROR"
+    assert attempts["n"] == 1
+
+
+def test_trial_retries_exhausted(tmp_path, seed):
+    attempts = {"n": 0}
+
+    def fn(config):
+        attempts["n"] += 1
+        raise RuntimeError("always broken")
+
+    analysis = tune.run(fn, config={}, max_failures=2,
+                        metric="loss", mode="min",
+                        local_dir=str(tmp_path),
+                        raise_on_failed_trial=False)
+    (trial,) = analysis.trials
+    assert trial.status == "ERROR"
+    assert attempts["n"] == 3          # initial + 2 retries
+    assert "always broken" in trial.error
+
+
 def test_report_outside_trial_raises():
     with pytest.raises(RuntimeError):
         tune.report(loss=1.0)
