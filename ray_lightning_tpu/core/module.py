@@ -146,6 +146,14 @@ class LightningModule:
       ``test_dataloader`` / ``predict_dataloader``
     """
 
+    #: Residency dtype for float params (``None`` = leave as initialized,
+    #: usually fp32).  Set to ``jnp.bfloat16`` (with an
+    #: ``ops.optim.fp32_master``-wrapped optimizer) to keep the live
+    #: params low-precision — deletes the per-step fp32->bf16 kernel
+    #: casts from the compiled program while the fp32 master copy in the
+    #: optimizer state preserves update precision.
+    param_dtype = None
+
     def __init__(self):
         self.trainer = None
         self.model = None

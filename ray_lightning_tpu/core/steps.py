@@ -28,7 +28,14 @@ def build_init_fn(module, tx) -> Callable:
         variables = dict(module.init_params(init_rng, batch))
         params = variables.pop("params")
         model_state = variables
+        # opt init sees the full-precision init values: an fp32_master tx
+        # snapshots its master copy *before* any residency downcast
         opt_state = tx.init(params)
+        pd = getattr(module, "param_dtype", None)
+        if pd is not None:
+            params = jax.tree_util.tree_map(
+                lambda p: p.astype(pd)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
         return TrainState.create(params, model_state, opt_state, state_rng)
 
     return init_fn
@@ -90,8 +97,14 @@ def build_train_step(module, tx,
                 acc_g = jax.tree_util.tree_map(jnp.add, acc["g"], grads)
                 return (ms, {"g": acc_g, "_i": acc["_i"] + 1}), (loss, logged)
 
+            # accumulate in fp32 regardless of param residency dtype: k
+            # bf16 additions would lose low bits the optimizer needs
             zero_g = jax.tree_util.tree_map(
-                lambda p: jnp.zeros_like(p), state.params)
+                lambda p: jnp.zeros(
+                    p.shape,
+                    jnp.float32 if jnp.issubdtype(p.dtype, jnp.floating)
+                    else p.dtype),
+                state.params)
             (new_ms, acc), (losses, logged_seq) = jax.lax.scan(
                 body, (state.model_state, {"g": zero_g, "_i": jnp.zeros(
                     (), jnp.int32)}), micro)

@@ -249,6 +249,16 @@ class GPTLightningModule(LightningModule):
     def configure_model(self):
         return GPT(self.config)
 
+    @property
+    def param_dtype(self):
+        # bf16-resident params (RLT_BF16_PARAMS=0 opts out): deletes the
+        # per-step fp32->bf16 kernel casts (~8.7 ms/step of dtype-convert
+        # fusions in the gpt2-small device trace) and halves DDP gradient
+        # bytes; the fp32 master copy in the optimizer state
+        # (ops/optim.py fp32_master) keeps update precision
+        return (jnp.bfloat16
+                if os.environ.get("RLT_BF16_PARAMS", "1") != "0" else None)
+
     def configure_optimizers(self):
         sched = optax.linear_schedule(0.0, self.lr, self.warmup_steps)
         # bf16 first moment (RLT_BF16_MOMENTS=0 opts out): halves mu's
@@ -258,8 +268,12 @@ class GPTLightningModule(LightningModule):
         mu_dtype = (jnp.bfloat16
                     if os.environ.get("RLT_BF16_MOMENTS", "1") != "0"
                     else None)
-        return optax.adamw(sched, weight_decay=self.weight_decay,
-                           b1=0.9, b2=0.95, mu_dtype=mu_dtype)
+        tx = optax.adamw(sched, weight_decay=self.weight_decay,
+                         b1=0.9, b2=0.95, mu_dtype=mu_dtype)
+        if self.param_dtype is not None:
+            from ray_lightning_tpu.ops.optim import fp32_master
+            tx = fp32_master(tx)
+        return tx
 
     def _loss(self, ctx, batch):
         x, y = batch
@@ -275,6 +289,15 @@ class GPTLightningModule(LightningModule):
             table = ctx.params["wte"]["embedding"]
             return chunked_softmax_cross_entropy(
                 h, table, y, self.config.chunked_ce)
+        if os.environ.get("RLT_FUSED_CE", "1") != "0":
+            # default full-vocab loss: bf16-resident logits, fp32
+            # accumulation inside the reduction fusions (ops/losses.py
+            # fused_lm_cross_entropy — measured win on the v5e headline;
+            # RLT_FUSED_CE=0 restores the fp32-logits path)
+            from ray_lightning_tpu.ops.losses import fused_lm_cross_entropy
+            h = ctx.apply(x, not ctx.training, method=GPT.hidden)
+            table = ctx.params["wte"]["embedding"]
+            return fused_lm_cross_entropy(h, table, y)
         logits = ctx.apply(x, not ctx.training)
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, y).mean()

@@ -29,6 +29,40 @@ import optax
 _log = logging.getLogger(__name__)
 
 
+def fused_lm_cross_entropy(hidden, table, targets):
+    """Full-vocab CE that never writes fp32 logits to HBM.
+
+    The naive tied-head path (``wte.attend(h).astype(f32)`` → optax CE)
+    materializes BOTH an fp32 [B,T,V] logits tensor (~1.6 GB at
+    gpt2-small scale) and a bf16 copy saved for the softmax recompute —
+    measured 3.76 ms at 2.56 GB accessed for the forward head fusion
+    alone (benchmarks/profile_headline.py roofline).  Here the head
+    matmul emits logits in the compute dtype once, and the
+    max/logsumexp/label-gather reductions upcast per-element *inside*
+    their fusions (fp32 accumulators, nothing fp32 ever hits HBM).
+    Forward precision matches the naive path: its fp32 logits were
+    produced by a bf16-operand matmul, so they carry the same rounding
+    this path keeps.
+
+    hidden: [B, T, D] compute dtype; table: [V, D] tied embedding;
+    targets: [B, T] int labels.  Returns mean token CE (fp32 scalar).
+    """
+    logits = jax.lax.dot_general(
+        hidden, table.astype(hidden.dtype),
+        (((2,), (1,)), ((), ())))                      # [B, T, V] bf16
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1))
+    # upcast BEFORE the max subtraction: both casts are exact (m is one
+    # of the logits) and stay elementwise inside the reduction fusion,
+    # so the exp argument carries full fp32 precision — identical to the
+    # naive path — while still no fp32 [B,T,V] tensor hits HBM
+    shifted = logits.astype(jnp.float32) - m.astype(jnp.float32)[..., None]
+    sumexp = jnp.sum(jnp.exp(shifted), axis=-1)
+    lse = jnp.log(sumexp) + m.astype(jnp.float32)
+    logit_y = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0]
+    return (lse - logit_y.astype(jnp.float32)).mean()
+
+
 def chunked_softmax_cross_entropy(hidden, table, targets,
                                   n_chunks: int = 8):
     """Mean token cross-entropy of ``hidden @ table.T`` against targets,

@@ -95,3 +95,58 @@ def test_gpt_hidden_plus_chunked_matches_call(seed):
     chunked = chunked_softmax_cross_entropy(h, table, tgt, 4)
     np.testing.assert_allclose(np.asarray(chunked), np.asarray(full),
                                rtol=2e-2, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# fused_lm_cross_entropy: the default full-vocab path (bf16-resident
+# logits, fp32 in-fusion accumulation) — must match the naive path in
+# value and gradient; it is an HBM-traffic optimization, not semantics.
+# ---------------------------------------------------------------------------
+
+def _naive(h, w, targets):
+    from jax import numpy as jnp
+    logits = jnp.einsum("btd,vd->btv", h, w).astype(jnp.float32)
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, targets).mean()
+
+
+def test_fused_ce_matches_full_vocab_fp32(seed):
+    from ray_lightning_tpu.ops.losses import fused_lm_cross_entropy
+    B, T, D, V = 2, 8, 16, 64
+    kh, kt, ky = jax.random.split(jax.random.PRNGKey(2), 3)
+    hidden = jax.random.normal(kh, (B, T, D), jnp.float32)
+    table = jax.random.normal(kt, (V, D), jnp.float32)
+    targets = jax.random.randint(ky, (B, T), 0, V)
+    np.testing.assert_allclose(
+        np.asarray(fused_lm_cross_entropy(hidden, table, targets)),
+        np.asarray(_naive(hidden, table, targets)), rtol=1e-5)
+
+
+def test_fused_ce_gradients_match_fp32(seed):
+    from ray_lightning_tpu.ops.losses import fused_lm_cross_entropy
+    B, T, D, V = 2, 8, 16, 64
+    kh, kt, ky = jax.random.split(jax.random.PRNGKey(3), 3)
+    hidden = jax.random.normal(kh, (B, T, D), jnp.float32)
+    table = jax.random.normal(kt, (V, D), jnp.float32)
+    targets = jax.random.randint(ky, (B, T), 0, V)
+    gf = jax.grad(_naive, argnums=(0, 1))(hidden, table, targets)
+    gz = jax.grad(fused_lm_cross_entropy, argnums=(0, 1))(
+        hidden, table, targets)
+    for a, b in zip(gf, gz):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=1e-6)
+
+
+def test_fused_ce_matches_naive_bf16(seed):
+    """In the compute dtype the two paths share the bf16 matmul rounding;
+    values agree to bf16-level tolerance."""
+    from ray_lightning_tpu.ops.losses import fused_lm_cross_entropy
+    B, T, D, V = 2, 16, 32, 128
+    kh, kt, ky = jax.random.split(jax.random.PRNGKey(4), 3)
+    hidden = jax.random.normal(kh, (B, T, D), jnp.bfloat16)
+    table = jax.random.normal(kt, (V, D), jnp.bfloat16)
+    targets = jax.random.randint(ky, (B, T), 0, V)
+    fused = float(fused_lm_cross_entropy(hidden, table, targets))
+    ref = float(_naive(hidden.astype(jnp.float32),
+                       table.astype(jnp.float32), targets))
+    assert abs(fused - ref) < 0.05 * max(1.0, abs(ref))
