@@ -191,23 +191,168 @@ def _use_tri(causal: bool, bq: int, bk: int, nq: int) -> bool:
             and os.environ.get("RLT_FLASH_TRI", "1") != "0")
 
 
-def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    """Core forward on [BH, T, D] arrays → (o, lse[BH, T, 1])."""
-    bh, t, d = q.shape
+# -- head-packed single-block kernels (transpose-free fast path) ------------
+#
+# Mosaic requires a block's last dim to be a 128 multiple (or span the
+# whole array), so slicing ONE d=64 head out of a [B, T, C] array is not
+# expressible.  Packing ``128 // d`` heads into one 128-lane block is:
+# the kernel loops over the packed heads with static column slices (the
+# loop unrolls at trace time; slices are in-VMEM).  This keeps q/k/v in
+# the qkv Dense's native [B, T, C] layout end-to-end — the old
+# ``[B,T,H,D] → transpose → [B·H,T,D]`` fold cost ~3.6 ms/step of pure
+# data-formatting on the gpt2-small headline (roofline trace).  Engaged
+# for the single-block case (T ≤ 1024 by default), where a plain
+# max-shifted softmax replaces the online rescaling (whole row visible)
+# and ``delta`` is computed in-kernel; longer sequences keep the folded
+# multi-block kernels below.
+
+
+def _head_pack(d: int, h: int) -> int:
+    """Heads per 128-lane block (0 = layout not packable)."""
+    if d <= 128 and 128 % d == 0:
+        pack = 128 // d
+    elif d % 128 == 0:
+        pack = 1
+    else:
+        return 0
+    return pack if h % pack == 0 else 0
+
+
+def _fwd_packed_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       *, sm_scale, causal, block, d, pack):
+    for j in range(pack):
+        sl = slice(j * d, (j + 1) * d)
+        q = q_ref[0][:, sl]
+        k = k_ref[0][:, sl]
+        v = v_ref[0][:, sl]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale      # [T, T]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)                   # [T, 1]
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        acc = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        o_ref[0, :, sl] = (acc / l).astype(o_ref.dtype)
+        lse_ref[0, 0, :, j:j + 1] = m + jnp.log(l)
+
+
+def _bwd_packed_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                       dq_ref, dk_ref, dv_ref,
+                       *, sm_scale, causal, block, d, pack):
+    """Single-block packed backward: one :func:`_single_block_bwd_math`
+    call per packed head, with in-kernel delta."""
+    for j in range(pack):
+        sl = slice(j * d, (j + 1) * d)
+        o = o_ref[0][:, sl]
+        do = do_ref[0][:, sl]
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)                  # [T, 1]
+        dq, dk, dv = _single_block_bwd_math(
+            q_ref[0][:, sl], k_ref[0][:, sl], v_ref[0][:, sl], do,
+            lse_ref[0, 0][:, j:j + 1], delta,
+            sm_scale=sm_scale, causal=causal, block=block)
+        dv_ref[0, :, sl] = dv.astype(dv_ref.dtype)
+        dk_ref[0, :, sl] = dk.astype(dk_ref.dtype)
+        dq_ref[0, :, sl] = dq.astype(dq_ref.dtype)
+
+
+def _fwd_packed(q, k, v, h, causal, sm_scale, interpret):
+    b, t, c = q.shape
+    d = c // h
+    pack = _head_pack(d, h)
+    g2 = h // pack
+    w = pack * d
+    kernel = functools.partial(_fwd_packed_kernel, sm_scale=sm_scale,
+                               causal=causal, block=t, d=d, pack=pack)
+    x_spec = pl.BlockSpec((1, t, w), lambda g: (g // g2, 0, g % g2))
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(b * g2,),
+        in_specs=[x_spec, x_spec, x_spec],
+        out_specs=[
+            x_spec,
+            pl.BlockSpec((1, 1, t, pack), lambda g: (g // g2, g % g2, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t, c), q.dtype),
+            jax.ShapeDtypeStruct((b, g2, t, pack), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _bwd_packed(q, k, v, h, o, lse, do, causal, sm_scale, interpret):
+    b, t, c = q.shape
+    d = c // h
+    pack = _head_pack(d, h)
+    g2 = h // pack
+    w = pack * d
+    kernel = functools.partial(_bwd_packed_kernel, sm_scale=sm_scale,
+                               causal=causal, block=t, d=d, pack=pack)
+    x_spec = pl.BlockSpec((1, t, w), lambda g: (g // g2, 0, g % g2))
+    r_spec = pl.BlockSpec((1, 1, t, pack), lambda g: (g // g2, g % g2, 0, 0))
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=(b * g2,),
+        in_specs=[x_spec, x_spec, x_spec, x_spec, x_spec, r_spec],
+        out_specs=[x_spec, x_spec, x_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t, c), q.dtype),
+            jax.ShapeDtypeStruct((b, t, c), k.dtype),
+            jax.ShapeDtypeStruct((b, t, c), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, o, do, lse)
+    return dq, dk, dv
+
+
+def _fold(x, b, t, h, d):
+    """[B, T, H·D] → [B·H, T, D] (the multi-block kernels' layout)."""
+    return x.reshape(b, t, h, d).transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _unfold(x, b, t, h, d):
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3).reshape(b, t, h * d)
+
+
+def _fwd(q, k, v, h, causal, sm_scale, block_q, block_k, interpret):
+    """Core forward on head-packed [B, T, C] arrays.
+
+    Single-block shapes take the transpose-free packed path; longer
+    sequences fold to [B·H, T, D] for the tiled/triangular kernels.
+    Returns ``(o[B,T,C], lse)`` where lse's layout depends on the path
+    taken (packed: [B, H/pack, T, pack]; folded: [B·H, T, 1]) — the
+    matching ``_bwd`` branch consumes it.
+    """
+    b, t, c = q.shape
+    d = c // h
+    bh = b * h
     bq = _pick_block(t, block_q)
     bk = _pick_block(t, block_k)
     nq, nk = t // bq, t // bk
+
+    if nq == 1 and nk == 1 and _head_pack(d, h):
+        return _fwd_packed(q, k, v, h, causal, sm_scale, interpret)
+
+    q, k, v = (_fold(x, b, t, h, d) for x in (q, k, v))
 
     if _use_tri(causal, bq, bk, nq):
         n_tri = nq * (nq + 1) // 2
         kernel = functools.partial(_fwd_tri_kernel, sm_scale=sm_scale,
                                    block=bq)
 
-        def q_map(b, i):
-            return (b, _tri_decode(i)[0], 0)
+        def q_map(g, i):
+            return (g, _tri_decode(i)[0], 0)
 
-        def k_map(b, i):
-            return (b, _tri_decode(i)[1], 0)
+        def k_map(g, i):
+            return (g, _tri_decode(i)[1], 0)
 
         o, lse = pl.pallas_call(
             kernel,
@@ -232,7 +377,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             ],
             interpret=interpret,
         )(q, k, v)
-        return o, lse
+        return _unfold(o, b, t, h, d), lse
 
     grid = (bh, nq, nk)
 
@@ -242,13 +387,13 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda g, i, j: (g, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
@@ -261,7 +406,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(q, k, v)
-    return o, lse
+    return _unfold(o, b, t, h, d), lse
 
 
 # ---------------------------------------------------------------------------
@@ -366,6 +511,80 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = (dq_acc[:] * sm_scale).astype(dq_ref.dtype)
 
 
+def _single_block_bwd_math(q, k, v, do, lse, delta, *, sm_scale, causal,
+                           block):
+    """Shared 5-matmul single-block backward: the one place the dq/dk/dv
+    math lives, used by both the folded fused kernel and the head-packed
+    kernel (one call per packed head) so the two paths cannot diverge.
+    Returns fp32 (dq, dk, dv) tiles; callers cast to storage dtype.
+
+    The two-kernel decomposition exists because dK/dV and dQ accumulate
+    over different grid axes — but with nq == nk == 1 there is nothing
+    to accumulate, and splitting costs two extra [T,T] matmuls per head
+    (s and dp recomputed in the dQ kernel): 7 MXU passes where 5
+    suffice.  At the T=1024 headline that is ~29% of the backward FLOPs
+    for free.  Same math, same dtypes, same order as the split kernels.
+    """
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale          # [T, T]
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    p = jnp.exp(s - lse)                                         # [T, T] f32
+    dv = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dsc = ds.astype(q.dtype)
+    dk = jax.lax.dot_general(
+        dsc, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    dq = jax.lax.dot_general(
+        dsc, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    return dq, dk, dv
+
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, *, sm_scale, causal, block):
+    """One-pass single-block backward on folded [B·H, T, D] tiles
+    (see :func:`_single_block_bwd_math`)."""
+    dq, dk, dv = _single_block_bwd_math(
+        q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0], delta_ref[0],
+        sm_scale=sm_scale, causal=causal, block=block)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_fused(q, k, v, lse, do, delta, causal, sm_scale, interpret):
+    """Single-block backward on folded [B·H, T, D] (when the packed
+    layout does not apply): grid over batch·heads only."""
+    bh, t, d = q.shape
+    kernel = functools.partial(_bwd_fused_kernel, sm_scale=sm_scale,
+                               causal=causal, block=t)
+    x_spec = pl.BlockSpec((1, t, d), lambda g: (g, 0, 0))
+    r_spec = pl.BlockSpec((1, t, 1), lambda g: (g, 0, 0))
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=(bh,),
+        in_specs=[x_spec, x_spec, x_spec, x_spec, r_spec, r_spec],
+        out_specs=[x_spec, x_spec, x_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
 def _bwd_dkdv_tri_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dk_ref, dv_ref, dk_acc, dv_acc,
                          *, sm_scale, block: int, n: int):
@@ -448,11 +667,11 @@ def _bwd_tri(q, k, v, o, lse, do, sm_scale, bq, nq, delta, interpret):
     bh, t, d = q.shape
     n_tri = nq * (nq + 1) // 2
 
-    def ki_map(b, i):
-        return (b, _tri_decode_rev(i, nq)[0], 0)
+    def ki_map(g, i):
+        return (g, _tri_decode_rev(i, nq)[0], 0)
 
-    def qi_rev_map(b, i):
-        return (b, _tri_decode_rev(i, nq)[1], 0)
+    def qi_rev_map(g, i):
+        return (g, _tri_decode_rev(i, nq)[1], 0)
 
     dkdv = functools.partial(_bwd_dkdv_tri_kernel, sm_scale=sm_scale,
                              block=bq, n=nq)
@@ -482,11 +701,11 @@ def _bwd_tri(q, k, v, o, lse, do, sm_scale, bq, nq, delta, interpret):
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
-    def q_map(b, i):
-        return (b, _tri_decode(i)[0], 0)
+    def q_map(g, i):
+        return (g, _tri_decode(i)[0], 0)
 
-    def k_map(b, i):
-        return (b, _tri_decode(i)[1], 0)
+    def k_map(g, i):
+        return (g, _tri_decode(i)[1], 0)
 
     dqk = functools.partial(_bwd_dq_tri_kernel, sm_scale=sm_scale, block=bq)
     dq = pl.pallas_call(
@@ -508,92 +727,107 @@ def _bwd_tri(q, k, v, o, lse, do, sm_scale, bq, nq, delta, interpret):
     return dq, dk, dv
 
 
-def _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k, interpret):
-    bh, t, d = q.shape
+def _bwd(q, k, v, h, o, lse, do, causal, sm_scale, block_q, block_k,
+         interpret):
+    """Backward on head-packed [B, T, C]; must mirror ``_fwd``'s branch
+    (the packed path's residuals carry a [B, H/pack, T, pack] lse)."""
+    b, t, c = q.shape
+    d = c // h
+    bh = b * h
     bq = _pick_block(t, block_q)
     bk = _pick_block(t, block_k)
     nq, nk = t // bq, t // bk
+
+    if nq == 1 and nk == 1 and _head_pack(d, h):
+        return _bwd_packed(q, k, v, h, o, lse, do, causal, sm_scale,
+                           interpret)
+
+    q, k, v, o, do = (_fold(x, b, t, h, d) for x in (q, k, v, o, do))
 
     # delta_i = Σ_d dO_id · O_id — tiny elementwise+reduce; XLA fuses it
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)                      # [bh, t, 1]
 
-    if _use_tri(causal, bq, bk, nq):
-        return _bwd_tri(q, k, v, o, lse, do, sm_scale, bq, nq, delta,
-                        interpret)
+    if nq == 1 and nk == 1:
+        dq, dk, dv = _bwd_fused(q, k, v, lse, do, delta, causal, sm_scale,
+                                interpret)
+    elif _use_tri(causal, bq, bk, nq):
+        dq, dk, dv = _bwd_tri(q, k, v, o, lse, do, sm_scale, bq, nq, delta,
+                              interpret)
+    else:
+        q_spec = pl.BlockSpec((1, bq, d), lambda g, i, j: (g, j, 0))
+        r_spec = pl.BlockSpec((1, bq, 1), lambda g, i, j: (g, j, 0))
+        k_by_i = pl.BlockSpec((1, bk, d), lambda g, i, j: (g, i, 0))
+        dkdv = functools.partial(_bwd_dkdv_kernel, sm_scale=sm_scale,
+                                 causal=causal, block_q=bq, block_k=bk,
+                                 nq=nq)
+        dk, dv = pl.pallas_call(
+            dkdv,
+            grid=(bh, nk, nq),
+            in_specs=[
+                q_spec,                                          # q by qi=j
+                k_by_i,                                          # k by ki
+                k_by_i,                                          # v by ki
+                q_spec,                                          # do
+                r_spec,                                          # lse
+                r_spec,                                          # delta
+            ],
+            out_specs=[k_by_i, k_by_i],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+                jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
 
-    q_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0))
-    r_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0))
-    dkdv = functools.partial(_bwd_dkdv_kernel, sm_scale=sm_scale,
-                             causal=causal, block_q=bq, block_k=bk, nq=nq)
-    dk, dv = pl.pallas_call(
-        dkdv,
-        grid=(bh, nk, nq),
-        in_specs=[
-            q_spec,                                              # q by qi=j
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),  # k by ki
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),  # v by ki
-            q_spec,                                              # do
-            r_spec,                                              # lse
-            r_spec,                                              # delta
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bk, d), jnp.float32),
-            pltpu.VMEM((bk, d), jnp.float32),
-        ],
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-
-    dqk = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
-                            causal=causal, block_q=bq, block_k=bk, nk=nk)
-    qi_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
-    ri_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
-    dq = pl.pallas_call(
-        dqk,
-        grid=(bh, nq, nk),
-        in_specs=[
-            qi_spec,
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            qi_spec,
-            ri_spec,
-            ri_spec,
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+        dqk = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
+                                causal=causal, block_q=bq, block_k=bk,
+                                nk=nk)
+        qi_spec = pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0))
+        ri_spec = pl.BlockSpec((1, bq, 1), lambda g, i, j: (g, i, 0))
+        k_by_j = pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0))
+        dq = pl.pallas_call(
+            dqk,
+            grid=(bh, nq, nk),
+            in_specs=[
+                qi_spec,
+                k_by_j,
+                k_by_j,
+                qi_spec,
+                ri_spec,
+                ri_spec,
+            ],
+            out_specs=qi_spec,
+            out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+    return tuple(_unfold(x, b, t, h, d) for x in (dq, dk, dv))
 
 
 # ---------------------------------------------------------------------------
-# custom-vjp wrapper on [BH, T, D]
+# custom-vjp wrapper on head-packed [B, T, C]
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, h, causal, sm_scale, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, h, causal, sm_scale, block_q, block_k, interpret)
     return o
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+def _flash_fwd(q, k, v, h, causal, sm_scale, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, h, causal, sm_scale, block_q, block_k, interpret)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(h, causal, sm_scale, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
-    return _bwd(q, k, v, o, lse, g, causal, sm_scale, block_q, block_k,
+    return _bwd(q, k, v, h, o, lse, g, causal, sm_scale, block_q, block_k,
                 interpret)
 
 
@@ -637,11 +871,10 @@ def flash_attention(q, k, v, *, causal: bool = True, dtype=jnp.bfloat16,
         sm_scale = 1.0 / math.sqrt(d)
     if interpret is None:
         interpret = _use_interpret()
-    # [B, T, H, D] → [B*H, T, D]
-    def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, x.shape[-1])
-
-    o = _flash(fold(q), fold(k), fold(v), causal, sm_scale, block_q,
+    # [B, T, H, D] → head-packed [B, T, C]: a FREE reshape (it is the
+    # qkv Dense output layout); the kernels' index maps slice each
+    # head's C columns, so no transpose ever hits HBM
+    o = _flash(q.reshape(b, t, h * d), k.reshape(b, t, h * d),
+               v.reshape(b, t, h * d), h, causal, sm_scale, block_q,
                block_k, interpret)
-    o = o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
-    return o.astype(dtype)
+    return o.reshape(b, t, h, d).astype(dtype)

@@ -113,3 +113,58 @@ def test_gpt_attention_impl_flash_trains():
                       log_every_n_steps=1)
     trainer.fit(module)
     assert np.isfinite(float(trainer.callback_metrics["loss"]))
+
+
+# -- head-packed single-block kernels (the production path at T<=1024) ------
+#
+# _head_pack engages when 128//d divides h; the default test shapes
+# (h=2, d=32 → pack=4 ∤ 2) never hit it, so these cases pin the packed
+# forward AND backward explicitly — a regression here would otherwise
+# ship under a green suite while being the path the headline runs.
+
+_PACKED_SHAPES = [
+    (4, 32),    # pack=4 divides h=4
+    (2, 64),    # pack=2 divides h=2 (the gpt2 head_dim)
+    (2, 128),   # pack=1, d == lane width
+]
+
+
+@pytest.mark.parametrize("h,d", _PACKED_SHAPES)
+@pytest.mark.parametrize("causal", [True, False])
+def test_packed_forward_matches_naive(h, d, causal):
+    from ray_lightning_tpu.ops.flash_attention import _head_pack
+    assert _head_pack(d, h) > 0
+    q, k, v = _rand_qkv(t=128, h=h, d=d)
+    out = flash_attention(q, k, v, causal=causal, dtype=jnp.float32)
+    ref = dot_product_attention(q, k, v, causal=causal, dtype=jnp.float32)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("h,d", _PACKED_SHAPES)
+def test_packed_grads_match_naive(h, d):
+    q, k, v = _rand_qkv(t=128, h=h, d=d)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, dtype=jnp.float32)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        o = dot_product_attention(q, k, v, causal=True, dtype=jnp.float32)
+        return jnp.sum(jnp.sin(o))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_odd_head_count_falls_back_to_folded():
+    """h=3 with d=64 (pack=2 ∤ 3) must take the folded path and still be
+    correct — the dispatch seam between the two layouts."""
+    from ray_lightning_tpu.ops.flash_attention import _head_pack
+    assert _head_pack(64, 3) == 0
+    q, k, v = _rand_qkv(t=128, h=3, d=64)
+    out = flash_attention(q, k, v, causal=True, dtype=jnp.float32)
+    ref = dot_product_attention(q, k, v, causal=True, dtype=jnp.float32)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
