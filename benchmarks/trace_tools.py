@@ -1,0 +1,197 @@
+"""Device-trace capture + bucketed breakdown for TPU benchmarking.
+
+Wraps ``jax.profiler.trace`` and parses the emitted Chrome-trace JSON to
+answer two questions the wall clock cannot (the tunnel between host and
+chip adds tens of ms of jitter per dispatch):
+
+- where does *device* time go per step (op-category buckets)?
+- what is the pure device time per step (compute + collectives), for
+  framework-vs-native ratios that hold even when the host link drifts?
+
+Used by ``bench_native_baseline.py`` (device-time ratio legs) and the
+ad-hoc perf work recorded in benchmarks/README.md.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import tempfile
+from typing import Callable
+
+
+def capture_trace(run: Callable[[], None], out_dir: str | None = None) -> str:
+    """Run ``run()`` under the JAX profiler; return the trace directory."""
+    import jax
+
+    out_dir = out_dir or tempfile.mkdtemp(prefix="rlt_trace_")
+    with jax.profiler.trace(out_dir):
+        run()
+    return out_dir
+
+
+def _latest_trace_json(trace_dir: str) -> str:
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.trace.json.gz")))
+    if not paths:
+        raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
+    return paths[-1]
+
+
+def _device_events(trace_path: str, track: str = "XLA Ops") -> list[dict]:
+    """Complete ('X') events on one device-side track.
+
+    Device processes are named ``/device:TPU:0`` etc. and carry nested
+    tracks — "Steps" ⊃ "XLA Modules" ⊃ "XLA Ops" — so callers must pick
+    ONE track or they double-count: per-op analysis wants "XLA Ops",
+    per-step wall time wants "XLA Modules".
+    """
+    with gzip.open(trace_path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    proc_names: dict = {}
+    thread_names: dict = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            proc_names[e.get("pid")] = e.get("args", {}).get("name", "")
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            thread_names[(e.get("pid"), e.get("tid"))] = \
+                e.get("args", {}).get("name", "")
+
+    def on_track(e) -> bool:
+        pname = proc_names.get(e.get("pid"), "")
+        tname = thread_names.get((e.get("pid"), e.get("tid")), "")
+        return "/device:" in pname and tname == track
+
+    return [e for e in events
+            if e.get("ph") == "X" and e.get("dur") and on_track(e)]
+
+
+def roofline(trace_dir: str, steps: int, *,
+             peak_tflops: float = 197.0, peak_gbps: float = 819.0,
+             k: int = 30) -> list[dict]:
+    """Per-op roofline table from the trace's own HLO cost metadata.
+
+    Each "XLA Ops" event carries ``model_flops`` and ``bytes_accessed``;
+    dividing by measured device time gives achieved TFLOP/s and GB/s,
+    and max(flops/peak_flops, bytes/peak_bw) gives the roofline-bound
+    fraction — ops far below 1.0 on *both* axes are overhead and
+    therefore levers.  Defaults are TPU v5e peaks (bf16 MXU ~197
+    TFLOP/s, HBM ~819 GB/s).
+
+    Returns rows sorted by total time: {op, category, source, ms_per_step,
+    count, tflops, gbps, bound_frac, bound_by}.
+    """
+    agg: dict[str, dict] = {}
+    for e in _device_events(_latest_trace_json(trace_dir)):
+        args = e.get("args", {})
+        # deduplicated_name: XLA emitted one program for several
+        # identical ops (e.g. the 12 per-layer attention kernels);
+        # aggregate under the canonical name + category
+        key = args.get("deduplicated_name") or e["name"]
+        row = agg.setdefault(key, {
+            "op": key,
+            "category": args.get("hlo_category", "?"),
+            "source": (args.get("tf_op") or args.get("source") or "")[:80],
+            "ms": 0.0, "count": 0, "flops": 0.0, "bytes": 0.0})
+        row["ms"] += e["dur"] / 1000.0
+        row["count"] += 1
+        row["flops"] += float(args.get("model_flops", 0) or 0)
+        row["bytes"] += float(args.get("bytes_accessed", 0) or 0)
+    rows = sorted(agg.values(), key=lambda r: -r["ms"])[:k]
+    for r in rows:
+        secs = r["ms"] / 1000.0
+        r["ms_per_step"] = round(r["ms"] / steps, 3)
+        r["tflops"] = round(r["flops"] / secs / 1e12, 1) if secs else 0.0
+        r["gbps"] = round(r["bytes"] / secs / 1e9, 1) if secs else 0.0
+        cf = r["tflops"] / peak_tflops
+        bf = r["gbps"] / peak_gbps
+        r["bound_frac"] = round(max(cf, bf), 2)
+        r["bound_by"] = "compute" if cf >= bf else "bandwidth"
+        del r["ms"], r["flops"], r["bytes"]
+    return rows
+
+
+def bucket_of(name: str) -> str:
+    """Coarse op-category for a device event name (HLO-ish)."""
+    n = name.lower()
+    if "pallas" in n or "custom-call" in n or "flash" in n:
+        return "pallas/custom"
+    if "convert" in n:
+        return "convert-fusion"
+    if "all-reduce" in n or "all-gather" in n or "reduce-scatter" in n \
+            or "collective" in n or "permute" in n:
+        return "collective"
+    if "multiply" in n and ("reduce" in n or "subtract" in n):
+        return "multiply-reduce-fusion"
+    if n.startswith("fusion") or ".fusion" in n:
+        return "generic-fusion"
+    if "dot" in n or "dense" in n or "conv" in n:
+        return "dot/conv"
+    if "copy" in n or "bitcast" in n or "transpose" in n:
+        return "copy/layout"
+    if "dynamic" in n or "gather" in n or "scatter" in n or "slice" in n:
+        return "gather/scatter"
+    if "reduce" in n or "add" in n:
+        return "reduce/add"
+    return "other"
+
+
+def device_breakdown(trace_dir: str) -> dict[str, float]:
+    """Total device time (ms) per bucket across the whole trace."""
+    out: dict[str, float] = collections.defaultdict(float)
+    for e in _device_events(_latest_trace_json(trace_dir)):
+        out[bucket_of(e["name"])] += e["dur"] / 1000.0
+    return dict(sorted(out.items(), key=lambda kv: -kv[1]))
+
+
+def top_ops(trace_dir: str, k: int = 25) -> list[tuple[str, float, int]]:
+    """(name, total ms, count) for the k most expensive device ops."""
+    tot: dict[str, float] = collections.defaultdict(float)
+    cnt: dict[str, int] = collections.defaultdict(int)
+    for e in _device_events(_latest_trace_json(trace_dir)):
+        tot[e["name"]] += e["dur"] / 1000.0
+        cnt[e["name"]] += 1
+    ranked = sorted(tot.items(), key=lambda kv: -kv[1])[:k]
+    return [(name, ms, cnt[name]) for name, ms in ranked]
+
+
+def dominant_module(trace_dir: str) -> tuple[str, float, int]:
+    """(name, median_ms, count) of the XLA module with the largest total
+    device time in the trace.
+
+    In a traced training window that module is the train step; taking
+    the MEDIAN event duration makes the figure robust to a first
+    execution inflated by compilation and to stragglers, and using
+    device-track module events makes it immune to host/tunnel jitter —
+    the property the framework-vs-native ratios need on transfer-bound
+    workloads (a wall clock cannot resolve the 0.9 bar when the tunnel
+    drifts ±2-4×, benchmarks/README.md).
+    """
+    import statistics
+
+    evs = _device_events(_latest_trace_json(trace_dir),
+                         track="XLA Modules")
+    agg: dict[str, list] = collections.defaultdict(list)
+    for e in evs:
+        agg[e["name"]].append(e["dur"] / 1000.0)
+    if not agg:
+        raise ValueError(f"no XLA module events under {trace_dir}")
+    name, durs = max(agg.items(), key=lambda kv: sum(kv[1]))
+    return name, float(statistics.median(durs)), len(durs)
+
+
+def total_device_ms(trace_dir: str, module_filter: str = "") -> float:
+    """Total device time (ms) spent executing XLA modules in the trace.
+
+    Uses the "XLA Modules" track (one event per module execution, no
+    nesting) so the result is pure device busy time — immune to host /
+    tunnel jitter.  ``module_filter``: only count modules whose name
+    contains it (e.g. "train_step" to exclude init/eval programs).
+    """
+    evs = _device_events(_latest_trace_json(trace_dir), track="XLA Modules")
+    return sum(e["dur"] / 1000.0 for e in evs
+               if module_filter in e.get("name", ""))
