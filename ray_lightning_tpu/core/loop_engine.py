@@ -1,0 +1,313 @@
+"""Batch sources for the trainer's single epoch engine.
+
+Round 2 grew three divergent epoch loops (streamed, chunked, cached)
+that triplicated limit/callback/val-interval semantics and shipped one
+real behavioral divergence (the cached loop froze batch membership
+across epochs while a shuffling streamed loader re-draws it).  The
+engine now has ONE loop (``Trainer._train_epoch``) over a *batch
+source*; the dispatch shape (per-batch, k-step chunk, device-resident
+gather) is the source's business, the semantics (limits, callbacks,
+metrics, val cadence) are the engine's and exist once.
+
+- :class:`StreamSource` — host batches from the loader.  chunk-size-1
+  take = the classic streamed loop; full-k takes stack into one
+  ``lax.scan`` dispatch (``steps_per_execution``).
+- :class:`CachedSource` — the device-resident train set.  Samples are
+  uploaded ONCE in dataset order (flat [N, ...]); each epoch the
+  loader's own index order drives a device-side *repack* into
+  [n_batches, B, ...], so batch membership exactly matches what the
+  streamed loop would have assembled — shuffle included (the round-2
+  frozen-membership divergence is gone by construction).  Per-step
+  dispatches then gather batch i on-device; only integer indices cross
+  the host→device link (the tunnel-bandwidth fix, benchmarks/README.md
+  config #1).  A trailing partial batch (drop_last=False) cannot ride
+  the fixed-shape cache and is assembled host-side and routed through
+  the single-step program instead (the np.stack shape crash of the
+  round-2 cache is structurally impossible here: samples stack at the
+  dataset level, where shapes are uniform by construction).
+
+Reference anchor: this replaces the reference's single hot loop
+(ray_ddp.py:472 — PL ``run_stage`` inside each worker) rather than
+mirroring it; the chunk/cache shapes exist because a tunneled TPU makes
+per-step host work the bottleneck the reference never had.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_log = logging.getLogger(__name__)
+
+
+@dataclass
+class Item:
+    """One pending training step.
+
+    ``payload`` is a host batch (``kind="host"``) or an int batch index
+    into the source's repacked device cache (``kind="cached"``).
+    ``batch`` materializes the host-side batch for callbacks, lazily so
+    cached epochs do not pay host collation unless something looks.
+    """
+
+    batch_idx: int
+    kind: str                      # "host" | "cached"
+    payload: Any
+    _batch_fn: Callable[[], Any] = None
+
+    _materialized: Any = None
+
+    def batch(self):
+        if self._materialized is None and self._batch_fn is not None:
+            self._materialized = self._batch_fn()
+            self._batch_fn = None
+        return self._materialized if self._materialized is not None \
+            else self.payload
+
+
+class StreamSource:
+    """Host batches straight from the loader (one fresh pass per epoch)."""
+
+    def __init__(self, trainer, loader, strategy):
+        self._trainer = trainer
+        self._strategy = strategy
+        self._it = enumerate(loader)
+        self.exhausted = False
+
+    def take(self, n: int) -> list:
+        """Up to ``n`` acceptable batches, honoring ``limit_train_batches``
+        (which counts loader POSITIONS, not accepted batches — the
+        contract shared by every dispatch path)."""
+        t = self._trainer
+        out: list = []
+        while len(out) < n and not self.exhausted:
+            try:
+                batch_idx, batch = next(self._it)
+            except StopIteration:
+                self.exhausted = True
+                break
+            if t.limit_train_batches is not None \
+                    and batch_idx >= t.limit_train_batches:
+                self.exhausted = True
+                break
+            if t._batch_ok(batch, self._strategy):
+                out.append(Item(batch_idx=batch_idx, kind="host",
+                                payload=batch))
+        return out
+
+    def chunkable(self, items: list) -> bool:
+        """A chunk stacks host batches — every leaf shape must agree
+        (a ragged final batch otherwise crashes the np.stack)."""
+        if any(it.kind != "host" for it in items):
+            return False
+        shapes = [
+            tuple(x.shape for x in jax.tree_util.tree_leaves(
+                jax.tree_util.tree_map(np.asarray, it.payload)))
+            for it in items]
+        return all(s == shapes[0] for s in shapes)
+
+    def run_one(self, trainer, item: Item):
+        gbatch = trainer._put_batch(item.payload, self._strategy)
+        trainer.state, metrics = trainer._train_step(trainer.state, gbatch)
+        return metrics
+
+    def run_chunk(self, trainer, items: list):
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs), *[it.payload for it in items])
+        gbatch = trainer._put_batch(stacked, self._strategy, stacked=True)
+        trainer.state, metrics = trainer._multi_train_step(
+            trainer.state, gbatch)
+        return metrics
+
+
+class CachedSource:
+    """Device-resident train set with per-epoch membership-accurate
+    repacking (module docstring).  Built once per fit; ``new_epoch``
+    refreshes the plan from the loader's index order."""
+
+    def __init__(self, trainer, loader, strategy):
+        self._trainer = trainer
+        self._loader = loader
+        self._strategy = strategy
+        self._flat = None              # device pytree [N, ...]
+        self._repacked = None          # device pytree [nb, B, ...]
+        self._last_perm: Optional[np.ndarray] = None
+        self._repack_jit = None
+        self._plan: list = []          # epoch's Items
+        self._pos = 0
+        self._host_memo: Optional[dict] = None
+        self._host_memo_perm: Optional[np.ndarray] = None
+        self.exhausted = False
+
+    # -- construction ---------------------------------------------------
+
+    @staticmethod
+    def usable(trainer, loader) -> bool:
+        """The cache needs the loader's anatomy (dataset + index order +
+        collate); foreign loaders fall back to streaming with a note."""
+        ok = all(hasattr(loader, a) for a in
+                 ("dataset", "_indices", "collate_fn", "batch_size",
+                  "drop_last")) \
+            and hasattr(loader.dataset, "__len__") \
+            and hasattr(loader.dataset, "__getitem__") \
+            and len(loader.dataset) > 0 and loader.batch_size > 0
+        if not ok:
+            _log.warning(
+                "cache_train_dataset needs a ray_lightning_tpu DataLoader "
+                "over an indexable dataset; got %r — streaming instead.",
+                type(loader).__name__)
+        return ok
+
+    def _gather_host(self, sample_ids) -> Any:
+        """Host batch of the given sample ids (zero-copy view for
+        contiguous ids over an ArrayDataset — the no-shuffle hot case,
+        where this runs per batch for callback arguments; vectorized
+        gather otherwise; per-sample collate for foreign datasets)."""
+        from ray_lightning_tpu.core.data import ArrayDataset
+        ds = self._loader.dataset
+        ids = np.asarray(sample_ids)
+        if isinstance(ds, ArrayDataset):
+            if len(ids) and np.array_equal(
+                    ids, np.arange(ids[0], ids[0] + len(ids))):
+                return ds[slice(int(ids[0]), int(ids[0]) + len(ids))]
+            return ds[ids]
+        return self._loader.collate_fn([ds[int(i)] for i in ids])
+
+    def build(self) -> bool:
+        """Upload all samples (dataset order) to device; False = unusable
+        (caller streams instead; nothing has been consumed from the
+        loader — the cache reads the DATASET, not the iterator)."""
+        t = self._trainer
+        loader = self._loader
+        n = len(loader.dataset)
+        flat = self._gather_host(np.arange(n))
+        flat = t._host_cast(flat)
+        leaves = jax.tree_util.tree_leaves(flat)
+        if not leaves or any(x.shape[0] != n for x in leaves):
+            _log.warning(
+                "cache_train_dataset: collated dataset is not [N, ...]-"
+                "shaped; streaming instead.")
+            return False
+        shardings = self._flat_shardings(flat, n)
+        self._flat = jax.device_put(flat, shardings) \
+            if shardings is not None else jax.device_put(flat)
+        jax.block_until_ready(self._flat)
+
+        def repack(flat_dev, perm):
+            nb = perm.shape[0] // loader.batch_size
+            g = jax.tree_util.tree_map(
+                lambda f: jnp.take(f, perm, axis=0), flat_dev)
+            return jax.tree_util.tree_map(
+                lambda x: x.reshape((nb, loader.batch_size) + x.shape[1:]),
+                g)
+
+        kw = {}
+        if t._stacked_batch_shardings is not None:
+            kw["out_shardings"] = t._stacked_batch_shardings
+        self._repack_jit = jax.jit(repack, **kw)
+        return True
+
+    def _flat_shardings(self, flat, n):
+        t = self._trainer
+        if t._mesh is None or t._mesh.devices.size <= 1:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        dp = self._strategy.data_parallel_size(t._mesh)
+        if dp > 1 and n % dp == 0:
+            return self._strategy.batch_shardings(t._mesh, flat)
+        # N does not divide: replicate the flat copy (one-time cost;
+        # the per-step repacked arrays stay sharded)
+        return jax.tree_util.tree_map(
+            lambda _: NamedSharding(t._mesh, P()), flat)
+
+    # -- per-epoch plan --------------------------------------------------
+
+    def _epoch_indices(self) -> np.ndarray:
+        return np.asarray(self._loader._indices())
+
+    def new_epoch(self) -> "CachedSource":
+        t = self._trainer
+        loader = self._loader
+        idx = self._epoch_indices()
+        B = loader.batch_size
+        nb = len(idx) // B
+        if t.limit_train_batches is not None:
+            nb = min(nb, t.limit_train_batches)
+        perm = idx[:nb * B].astype(np.int32)
+        if self._last_perm is None or not np.array_equal(
+                perm, self._last_perm):
+            self._repacked = self._repack_jit(self._flat, perm)
+            self._last_perm = perm
+            if not getattr(loader, "shuffle", False):
+                # membership is fixed for the rest of the fit (the
+                # epoch index order is deterministic without shuffle):
+                # drop the flat upload instead of pinning a second full
+                # dataset copy in device memory all fit long
+                self._flat = None
+        # host-batch memo for callback arguments: valid while membership
+        # (perm) is unchanged, so no-shuffle epochs collate each batch
+        # at most once per fit instead of once per epoch
+        if self._host_memo is None or not np.array_equal(
+                perm, self._host_memo_perm):
+            self._host_memo = {}
+            self._host_memo_perm = perm
+
+        def batch_of(sample_ids):
+            return t._host_cast(self._gather_host(sample_ids))
+
+        def memo_batch(j, sample_ids):
+            got = self._host_memo.get(j)
+            if got is None:
+                got = self._host_memo[j] = batch_of(sample_ids)
+            return got
+
+        self._plan = [
+            Item(batch_idx=j, kind="cached", payload=j,
+                 _batch_fn=(lambda j=j, s=idx[j * B:(j + 1) * B]:
+                            memo_batch(j, s)))
+            for j in range(nb)]
+        tail = idx[nb * B:]
+        under_limit = (t.limit_train_batches is None
+                       or nb < t.limit_train_batches)
+        if len(tail) and not loader.drop_last and under_limit \
+                and nb * B == len(idx) - len(tail):
+            tail_batch = batch_of(tail)
+            if t._batch_ok(tail_batch, self._strategy):
+                self._plan.append(Item(batch_idx=nb, kind="host",
+                                       payload=tail_batch))
+        self._pos = 0
+        self.exhausted = False
+        return self
+
+    # -- engine surface --------------------------------------------------
+
+    def take(self, n: int) -> list:
+        out = self._plan[self._pos:self._pos + n]
+        self._pos += len(out)
+        if self._pos >= len(self._plan):
+            self.exhausted = True
+        return out
+
+    def chunkable(self, items: list) -> bool:
+        return all(it.kind == "cached" for it in items)
+
+    def run_one(self, trainer, item: Item):
+        if item.kind == "host":
+            gbatch = trainer._put_batch(item.payload, self._strategy)
+            trainer.state, metrics = trainer._train_step(
+                trainer.state, gbatch)
+            return metrics
+        trainer.state, metrics = trainer._cached_single_step(
+            trainer.state, self._repacked, np.int32(item.payload))
+        return metrics
+
+    def run_chunk(self, trainer, items: list):
+        idxs = np.asarray([it.payload for it in items], dtype=np.int32)
+        trainer.state, metrics = trainer._cached_multi_step(
+            trainer.state, self._repacked, idxs)
+        return metrics

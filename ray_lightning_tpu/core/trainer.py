@@ -56,7 +56,7 @@ _RUNTIME_FIELDS = (
     "state", "_mesh", "_train_step", "_eval_steps", "_predict_step",
     "_state_shardings", "_abstract_state", "_tx", "_init_fn", "_init_rng",
     "_multi_train_step", "_stacked_batch_shardings",
-    "_cached_train", "_cached_multi_step", "_cached_single_step",
+    "_cache_source", "_cached_multi_step", "_cached_single_step",
 )
 
 # every spelling (PL 1.x and 2.x) that means "half-precision inputs";
@@ -120,12 +120,13 @@ class Trainer:
         # dispatch latency dominates compute (BASELINE config #1).
         # Batch-granular callbacks coarsen to once per chunk.
         self.steps_per_execution = max(1, int(steps_per_execution))
-        # opt-in device-resident train set: upload every train batch ONCE
-        # at fit start, then steps index into the cached arrays on-device
-        # — removing the per-step host→device batch transfer entirely
-        # (the measured bottleneck for small models on tunneled TPUs:
-        # ~28 MB/s link vs microsecond compute).  Batch membership is
-        # frozen after the first pass; order reshuffles per epoch.
+        # opt-in device-resident train set: samples upload ONCE (flat,
+        # dataset order), each epoch a device-side repack follows the
+        # loader's own index order (shuffle-accurate membership), and
+        # steps gather their batch on-device — removing the per-step
+        # host→device batch transfer entirely (the measured bottleneck
+        # for small models on tunneled TPUs: ~28 MB/s link vs
+        # microsecond compute).  See core/loop_engine.py CachedSource.
         # Single-process only; combine with steps_per_execution>1.
         self.cache_train_dataset = bool(cache_train_dataset)
         self.gradient_clip_val = gradient_clip_val
@@ -388,7 +389,8 @@ class Trainer:
         self._train_step = jax.jit(step_fn, **jit_kwargs)
         self._multi_train_step = None
         self._stacked_batch_shardings = None
-        self._cached_train = None
+        self._cache_source = None
+        self._cache_disabled = False
         self._cached_multi_step = None
         self._cached_single_step = None
         want_stacked = self.steps_per_execution > 1 or self.cache_train_dataset
@@ -617,188 +619,99 @@ class Trainer:
                 != self.global_step // self.log_every_n_steps:
             self._publish_metrics(last_metrics)
 
-    def _build_train_cache(self, train_loader, strategy) -> None:
-        """Upload the (limit-clamped) train set to device once.  The
-        one-time transfer replaces a per-step transfer every epoch —
-        the measured bottleneck for small models behind a TPU tunnel."""
-        batches = []
-        for batch_idx, batch in enumerate(train_loader):
-            if self.limit_train_batches is not None \
-                    and batch_idx >= self.limit_train_batches:
-                break
-            if self._batch_ok(batch, strategy):
-                batches.append(self._host_cast(batch))
-        if not batches:
-            return
-        stacked = jax.tree_util.tree_map(
-            lambda *xs: np.stack(xs), *batches)
-        if self._stacked_batch_shardings is not None:
-            dev = jax.device_put(stacked, self._stacked_batch_shardings)
-        else:
-            dev = jax.device_put(stacked)
-        jax.block_until_ready(dev)
-        self._cached_train = (dev, batches)
+    def _train_source(self, train_loader, strategy):
+        """Pick this epoch's batch source (core/loop_engine.py): the
+        device-resident cache when enabled and buildable, the streamed
+        loader otherwise.  The cache is built once per fit and refreshed
+        per epoch from the loader's own index order."""
+        from ray_lightning_tpu.core.loop_engine import (
+            CachedSource, StreamSource)
+        if self._cached_single_step is not None \
+                and not self._cache_disabled:
+            if self._cache_source is None \
+                    and CachedSource.usable(self, train_loader):
+                src = CachedSource(self, train_loader, strategy)
+                if src.build():
+                    self._cache_source = src
+            if self._cache_source is None:
+                # unusable with THIS loader: remember, so the build is
+                # not re-attempted (and the loader not re-read) per epoch
+                self._cache_disabled = True
+            else:
+                return self._cache_source.new_epoch()
+        return StreamSource(self, train_loader, strategy)
 
     def _train_epoch(self, module, train_loader, val_loader, strategy):
-        if self._cached_single_step is not None:
-            if self._cached_train is None:
-                self._build_train_cache(train_loader, strategy)
-            if self._cached_train is not None:
-                return self._train_epoch_cached(module, val_loader,
-                                                strategy)
-        if self.steps_per_execution > 1:
-            return self._train_epoch_chunked(module, train_loader,
-                                             val_loader, strategy)
-        for batch_idx, batch in enumerate(train_loader):
-            if self.should_stop or self._max_steps_reached():
-                break
-            if self.limit_train_batches is not None \
-                    and batch_idx >= self.limit_train_batches:
-                break
-            if not self._batch_ok(batch, strategy):
-                continue
-            self._dispatch_one(module, batch, batch_idx, strategy)
-            if self.val_check_interval \
-                    and self.global_step % self.val_check_interval == 0 \
-                    and val_loader is not None and self.num_val_batches > 0:
-                self._eval_loop(module, "validate", val_loader,
-                                self.limit_val_batches)
-            if self.should_stop or self._max_steps_reached():
-                break
+        """THE training loop — one engine for every dispatch shape.
 
-    def _dispatch_one(self, module, batch, batch_idx, strategy) -> None:
+        The source decides how batches reach the device (streamed host
+        batches, k-step stacked chunks, device-resident gathers); this
+        loop owns the semantics exactly once: stop conditions, chunk
+        boundaries (``_allowed_chunk`` keeps a chunk from crossing
+        max_steps / val_check_interval), ``limit_train_batches``
+        position counting (inside the sources' ``take``), callback
+        cadence (per batch when dispatching singly, per chunk when k
+        ride one dispatch) and the val-interval check after every
+        dispatch.  Replaces the round-2 trio of divergent loops.
+        """
+        source = self._train_source(train_loader, strategy)
+        k = self.steps_per_execution
+        while not (self.should_stop or self._max_steps_reached()):
+            allowed = self._allowed_chunk()
+            if allowed <= 0:
+                break
+            pending = source.take(allowed)
+            if not pending:
+                if source.exhausted:
+                    break
+                continue
+            if len(pending) == k and k > 1 and source.chunkable(pending):
+                self._engine_chunk(module, source, pending)
+                self._maybe_interval_val(module, val_loader)
+            else:
+                for item in pending:
+                    self._engine_one(module, source, item)
+                    self._maybe_interval_val(module, val_loader)
+                    if self.should_stop or self._max_steps_reached():
+                        break
+
+    def _maybe_interval_val(self, module, val_loader) -> None:
+        if self.val_check_interval \
+                and self.global_step % self.val_check_interval == 0 \
+                and val_loader is not None and self.num_val_batches > 0:
+            self._eval_loop(module, "validate", val_loader,
+                            self.limit_val_batches)
+
+    def _engine_one(self, module, source, item) -> None:
+        batch = item.batch()
         for cb in self.callbacks:
-            cb.on_train_batch_start(self, module, batch, batch_idx)
-        gbatch = self._put_batch(batch, strategy)
-        self.state, metrics = self._train_step(self.state, gbatch)
+            cb.on_train_batch_start(self, module, batch, item.batch_idx)
+        metrics = source.run_one(self, item)
         self.global_step += 1
         self._accumulate_metrics(metrics)
         if self.global_step % self.log_every_n_steps == 0:
             self._publish_metrics(metrics)
         for cb in self.callbacks:
-            cb.on_train_batch_end(self, module, metrics, batch, batch_idx)
+            cb.on_train_batch_end(self, module, metrics, batch,
+                                  item.batch_idx)
 
-    def _train_epoch_chunked(self, module, train_loader, val_loader,
-                             strategy):
-        """``steps_per_execution=k``: k optimizer steps ride ONE host
-        dispatch (the stacked batch is folded on-device by the compiled
-        ``lax.scan``) — k× fewer dispatches, which is the whole game for
-        small models on remote-tunnel TPUs (BASELINE config #1).
-
-        A chunk never crosses a host-decision boundary (max_steps,
-        limit_train_batches, val_check_interval); leftover batches that
-        cannot fill a chunk run through the single-step program, so no
-        extra compilation for ragged tails.  Batch-granular callbacks
-        fire once per chunk, with the chunk's stacked metrics and its
-        last batch.  ``limit_train_batches`` counts loader positions
-        (not accepted batches), matching the streamed loop exactly.
-        """
-        k = self.steps_per_execution
-        it = enumerate(train_loader)
-        exhausted = False
-        while not exhausted:
-            if self.should_stop or self._max_steps_reached():
-                break
-            allowed = self._allowed_chunk()
-            if allowed <= 0:
-                break
-            pending: list = []
-            while len(pending) < allowed:
-                try:
-                    batch_idx, batch = next(it)
-                except StopIteration:
-                    exhausted = True
-                    break
-                if self.limit_train_batches is not None \
-                        and batch_idx >= self.limit_train_batches:
-                    exhausted = True
-                    break
-                if self._batch_ok(batch, strategy):
-                    pending.append((batch_idx, batch))
-            if not pending:
-                continue
-            if len(pending) == k:
-                self._dispatch_chunk(module, pending, strategy)
-            else:
-                for batch_idx, batch in pending:
-                    self._dispatch_one(module, batch, batch_idx, strategy)
-            if self.val_check_interval \
-                    and self.global_step % self.val_check_interval == 0 \
-                    and val_loader is not None and self.num_val_batches > 0:
-                self._eval_loop(module, "validate", val_loader,
-                                self.limit_val_batches)
-
-    def _train_epoch_cached(self, module, val_loader, strategy):
-        """One epoch over the device-resident train set: steps gather
-        their batch on-device by index; only k int32 indices cross the
-        host→device link per dispatch.  Epoch 0 keeps the loader's
-        order; later epochs reshuffle the (frozen-membership) batches
-        with a seed+epoch-derived permutation."""
-        dataset_dev, host_batches = self._cached_train
-        n = len(host_batches)
-        k = self.steps_per_execution
-        if self.current_epoch == 0:
-            order = np.arange(n)
-        else:
-            order = np.random.default_rng(
-                [self.seed or 0, self.current_epoch]).permutation(n)
-        pos = 0
-        while pos < n:
-            if self.should_stop or self._max_steps_reached():
-                break
-            allowed = min(self._allowed_chunk(), n - pos)
-            if allowed <= 0:
-                break
-            idxs = order[pos:pos + allowed]
-            for j, bi in enumerate(idxs):
-                for cb in self.callbacks:
-                    cb.on_train_batch_start(self, module,
-                                            host_batches[bi], pos + j)
-            before = self.global_step
-            if allowed == k and k > 1:
-                self.state, metrics = self._cached_multi_step(
-                    self.state, dataset_dev,
-                    np.asarray(idxs, dtype=np.int32))
-                self.global_step += int(allowed)
-                self._accumulate_metrics(metrics)
-                last = jax.tree_util.tree_map(lambda a: a[-1], metrics)
-            else:
-                for bi in idxs:
-                    self.state, metrics = self._cached_single_step(
-                        self.state, dataset_dev, np.int32(bi))
-                    self.global_step += 1
-                    self._accumulate_metrics(metrics)
-                last = metrics
-            self._publish_if_crossed(before, last)
+    def _engine_chunk(self, module, source, items) -> None:
+        """k steps in ONE dispatch; batch-granular callbacks coarsen to
+        once per chunk (starts for every batch, one end with the chunk's
+        stacked metrics and its last batch)."""
+        for it in items:
             for cb in self.callbacks:
-                cb.on_train_batch_end(self, module, metrics,
-                                      host_batches[idxs[-1]],
-                                      pos + len(idxs) - 1)
-            pos += len(idxs)
-            if self.val_check_interval \
-                    and self.global_step % self.val_check_interval == 0 \
-                    and val_loader is not None and self.num_val_batches > 0:
-                self._eval_loop(module, "validate", val_loader,
-                                self.limit_val_batches)
-
-    def _dispatch_chunk(self, module, pending, strategy) -> None:
-        k = len(pending)
-        last_idx, last_batch = pending[-1]
-        for batch_idx, batch in pending:
-            for cb in self.callbacks:
-                cb.on_train_batch_start(self, module, batch, batch_idx)
-        stacked = jax.tree_util.tree_map(
-            lambda *xs: np.stack(xs), *[b for _, b in pending])
-        gbatch = self._put_batch(stacked, strategy, stacked=True)
+                cb.on_train_batch_start(self, module, it.batch(),
+                                        it.batch_idx)
         before = self.global_step
-        self.state, metrics = self._multi_train_step(self.state, gbatch)
-        self.global_step += k
+        metrics = source.run_chunk(self, items)
+        self.global_step += len(items)
         self._accumulate_metrics(metrics)
         self._publish_if_crossed(before, jax.tree_util.tree_map(
             lambda a: a[-1], metrics))
         for cb in self.callbacks:
-            cb.on_train_batch_end(self, module, metrics, last_batch,
-                                  last_idx)
+            cb.on_train_batch_end(self, module, metrics, items[-1].batch(),
+                                  items[-1].batch_idx)
 
     # -- metrics ---------------------------------------------------------
 
