@@ -9,18 +9,31 @@ trial-driver process (reference tune.py:130-134, :161-178).  This module
 detects that session and routes our relay payloads into it, so the same
 ``TuneReportCallback`` works under either runner.
 
-Two Ray API generations are supported, probed in order:
+Three Ray API generations are supported, probed in order:
 
 - **classic function-trainable API** (the one the reference binds):
-  ``ray.tune.report(**metrics)`` and ``with ray.tune.checkpoint_dir(step)``.
-- **modern Train API** (ray >= 2.x): ``ray.train.report(metrics,
-  checkpoint=Checkpoint.from_directory(dir))`` — a checkpoint can only
-  ride a report, so checkpoint payloads are *staged* and attached to the
-  next report (the callbacks fire checkpoint-then-report in that order
-  precisely so this pairing works, reference tune.py:234-236).
+  ``ray.tune.report(**metrics)`` and ``with ray.tune.checkpoint_dir(step)``
+  — detected via ``tune.is_session_enabled`` (reference tune.py:130-134).
+- **public context API** (newer ray, where ``is_session_enabled`` is
+  gone): ``ray.tune.get_context()`` returning a context with a live
+  trial id, reporting via ``ray.tune.report(metrics_dict,
+  checkpoint=...)`` (positional-dict signature).  Probed AHEAD of the
+  private path below, so a Ray release that drops its internals does
+  not strand the bridge.
+- **modern Train API via the private session** (last resort):
+  ``ray.train._internal.session.get_session`` +
+  ``ray.train.report(metrics, checkpoint=Checkpoint.from_directory(d))``.
+
+Under both non-classic generations a checkpoint can only ride a report,
+so checkpoint payloads are *staged* and attached to the next report
+(the callbacks fire checkpoint-then-report in that order precisely so
+this pairing works, reference tune.py:234-236).
 
 Everything is probed lazily and defensively: Ray absent, Ray present but
-no live session, and either API generation all behave sensibly.
+no live session, and any API generation all behave sensibly.  The
+builtin runner's thread-local session always wins over this bridge —
+tune/session.py probes it first (probe order is itself under test,
+tests/test_ray_tune_bridge.py).
 """
 
 from __future__ import annotations
@@ -61,8 +74,32 @@ def _classic_session_live() -> bool:
         return False
 
 
+def _tune_context():
+    """Live public-API tune context (``ray.tune.get_context()``), or None.
+
+    Recent Ray hands back a context object even outside a trial, so a
+    context only counts as live when it can produce a trial id.
+    """
+    try:
+        from ray import tune
+    except Exception:
+        return None
+    get_ctx = getattr(tune, "get_context", None)
+    if get_ctx is None:
+        return None
+    try:
+        ctx = get_ctx()
+        if ctx is None or not ctx.get_trial_id():
+            return None
+        return ctx
+    except Exception:
+        return None
+
+
 def _train_session():
-    """The modern Train-API session object, or None."""
+    """The modern Train-API session object via the PRIVATE module path.
+    Kept as the last probe: releases that drop the internals are served
+    by :func:`_tune_context` above."""
     try:
         from ray.train._internal.session import get_session
         return get_session()
@@ -72,7 +109,8 @@ def _train_session():
 
 def in_session() -> bool:
     """True when a real Ray Tune/Train session is live in this process."""
-    return _classic_session_live() or _train_session() is not None
+    return (_classic_session_live() or _tune_context() is not None
+            or _train_session() is not None)
 
 
 # -- report -----------------------------------------------------------------
@@ -88,24 +126,42 @@ def report(metrics: dict) -> bool:
         from ray import tune
         tune.report(**metrics)
         return True
+    if _tune_context() is not None:
+        from ray import tune
+        return _report_with_staged(lambda m, c: tune.report(m, checkpoint=c)
+                                   if c is not None else tune.report(m),
+                                   metrics)
     if _train_session() is not None:
         from ray import train
-        staged = getattr(_local, "pending_checkpoint", None)
-        _local.pending_checkpoint = None
-        if staged is not None:
-            checkpoint = _as_train_checkpoint(staged)
-            try:
-                train.report(dict(metrics), checkpoint=checkpoint)
-            finally:
-                shutil.rmtree(staged, ignore_errors=True)
-        else:
-            train.report(dict(metrics))
-        return True
+        return _report_with_staged(lambda m, c: train.report(m, checkpoint=c)
+                                   if c is not None else train.report(m),
+                                   metrics)
     return False
 
 
+def _report_with_staged(report_fn, metrics: dict) -> bool:
+    """Shared non-classic delivery: attach and consume any staged
+    checkpoint (it can only ride a report in these generations)."""
+    staged = getattr(_local, "pending_checkpoint", None)
+    _local.pending_checkpoint = None
+    if staged is not None:
+        checkpoint = _as_train_checkpoint(staged)
+        try:
+            report_fn(dict(metrics), checkpoint)
+        finally:
+            shutil.rmtree(staged, ignore_errors=True)
+    else:
+        report_fn(dict(metrics), None)
+    return True
+
+
 def _as_train_checkpoint(directory: str):
-    from ray.train import Checkpoint
+    # same class either way in real Ray; probe the tune alias first so a
+    # release that reorganizes ray.train keeps working
+    try:
+        from ray.tune import Checkpoint
+    except Exception:
+        from ray.train import Checkpoint
     return Checkpoint.from_directory(directory)
 
 
@@ -125,7 +181,7 @@ def stage_checkpoint(blob: bytes, step: int, filename: str) -> bool:
             with open(os.path.join(d, filename), "wb") as f:
                 f.write(blob)
         return True
-    if _train_session() is not None:
+    if _tune_context() is not None or _train_session() is not None:
         prev = getattr(_local, "pending_checkpoint", None)
         if prev is not None:
             # a checkpoint was staged but never reported (standalone
