@@ -51,6 +51,10 @@ class Trial:
         self.history: list[dict] = []
         self.latest_checkpoint: Optional[str] = None
         self.error: Optional[str] = None
+        #: device lease this trial ran on (in-process trials only;
+        #: populated at first acquire — tune/session.py) for post-hoc
+        #: "which chips ran this trial" debugging via ExperimentAnalysis
+        self.leased_devices: list[str] = []
 
     def __repr__(self):
         return f"Trial({self.trial_id}, {self.status})"
@@ -160,6 +164,17 @@ class _DeviceLeaser:
             raise ValueError(
                 f"resources_per_trial wants {self._per_trial} devices "
                 f"but only {len(devices)} are visible to this process")
+        stranded = len(devices) % self._per_trial
+        if stranded:
+            # the reference's placement groups make trial placement
+            # inspectable (reference tune.py:50-56); the least we owe the
+            # operator is a loud note that part of the host sits idle
+            _log.warning(
+                "resources_per_trial=%d does not divide the %d visible "
+                "devices: %d device(s) (%s) will sit idle under the "
+                "trial lease partition.", self._per_trial, len(devices),
+                stranded,
+                ", ".join(str(d) for d in devices[-stranded:]))
         self._chunks = [
             devices[i:i + self._per_trial]
             for i in range(0, len(devices) - self._per_trial + 1,

@@ -41,6 +41,10 @@ class TrialSession:
     def acquire_devices(self):
         if self._leaser is not None and self.devices is None:
             self.devices = self._leaser.acquire()
+            # record the lease on the trial for post-hoc debugging via
+            # ExperimentAnalysis (which chips ran which trial — the
+            # inspectability the reference gets from placement groups)
+            self.trial.leased_devices = [str(d) for d in self.devices]
         return self.devices
 
     def release_devices(self) -> None:
