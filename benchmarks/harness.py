@@ -19,7 +19,17 @@ import numpy as np
 
 def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
                       timed: int = 30, baseline: "float | None" = None,
-                      strategy=None, trainer_kwargs=None) -> dict:
+                      strategy=None, trainer_kwargs=None,
+                      trace_steps: int = 0) -> dict:
+    """Time steady-state steps; optionally profile a WARM tail.
+
+    ``trace_steps > 0``: after the timed window closes (and its sync
+    lands), the profiler traces that many additional steps of the SAME
+    fit — the compiled program is warm, so the tunnel profiler actually
+    records the step executions (tracing a fresh Trainer recompiles
+    inside the window and the device events never materialize).  The
+    result dict then carries ``trace_dir``.
+    """
     from ray_lightning_tpu import Trainer
     from ray_lightning_tpu.core.callbacks import Callback
 
@@ -33,6 +43,8 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
             self.start_step = None
             self.steps = None
             self.elapsed = None
+            self.trace_dir = None
+            self._last_metrics = None
 
         @staticmethod
         def _sync(metrics):
@@ -41,6 +53,7 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
             float(np.asarray(metrics["loss"]).ravel()[-1])
 
         def on_train_batch_end(self, trainer, mod, metrics, batch, idx):
+            self._last_metrics = metrics
             if self.t0 is None and trainer.global_step >= warmup:
                 self._sync(metrics)
                 self.start_step = trainer.global_step
@@ -50,13 +63,34 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
                 self._sync(metrics)
                 self.elapsed = time.monotonic() - self.t0
                 self.steps = trainer.global_step - self.start_step
+                if trace_steps > 0 and self.trace_dir is None:
+                    import tempfile
+
+                    import jax
+                    d = tempfile.mkdtemp(prefix="rlt_trace_")
+                    try:
+                        jax.profiler.start_trace(d)
+                    except Exception:   # profiler-less backends: the
+                        pass            # wall numbers must still emit
+                    else:
+                        self.trace_dir = d
+
+        def on_train_end(self, trainer, mod):
+            if self.trace_dir is not None:
+                import jax
+                if self._last_metrics is not None:
+                    self._sync(self._last_metrics)
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    self.trace_dir = None
 
     timer = Timer()
     # chunked dispatch rounds the warmup boundary up to a chunk edge, so
     # leave 2 chunks of slack past warmup+timed
     slack = 2 * (trainer_kwargs or {}).get("steps_per_execution", 1)
     trainer = Trainer(
-        max_steps=warmup + timed + slack, max_epochs=10**6,
+        max_steps=warmup + timed + slack + trace_steps, max_epochs=10**6,
         strategy=strategy,
         enable_checkpointing=False, num_sanity_val_steps=0,
         limit_val_batches=0, log_every_n_steps=10**9, callbacks=[timer],
@@ -71,4 +105,6 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
         "vs_baseline": round(steps_per_sec / (baseline or steps_per_sec), 3),
     }
     print(json.dumps(result))
+    if timer.trace_dir is not None:
+        result["trace_dir"] = timer.trace_dir
     return result

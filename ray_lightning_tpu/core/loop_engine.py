@@ -35,6 +35,7 @@ per-step host work the bottleneck the reference never had.
 from __future__ import annotations
 
 import logging
+import os
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
@@ -51,14 +52,17 @@ class Item:
 
     ``payload`` is a host batch (``kind="host"``) or an int batch index
     into the source's repacked device cache (``kind="cached"``).
-    ``batch`` materializes the host-side batch for callbacks, lazily so
-    cached epochs do not pay host collation unless something looks.
+    ``device`` carries an in-flight device transfer when the stream
+    source prefetched this batch (double-buffering).  ``batch``
+    materializes the host-side batch for callbacks, lazily so cached
+    epochs do not pay host collation unless something looks.
     """
 
     batch_idx: int
     kind: str                      # "host" | "cached"
     payload: Any
     _batch_fn: Callable[[], Any] = None
+    device: Any = None
 
     _materialized: Any = None
 
@@ -71,33 +75,78 @@ class Item:
 
 
 class StreamSource:
-    """Host batches straight from the loader (one fresh pass per epoch)."""
+    """Host batches straight from the loader (one fresh pass per epoch).
+
+    Single-process, per-step dispatch additionally DOUBLE-BUFFERS: the
+    transfer of batch k+1 (and k+2) is issued with ``jax.device_put``
+    — asynchronous — while step k still computes, so the host→device
+    copy rides under the compute instead of serializing with it (the
+    round-2 streamed path started each batch's transfer only at its own
+    dispatch; on the tunneled chip that stacked link time on top of
+    step time).  Chunked and multi-process dispatches keep their own
+    assembly paths (stacking / global-array construction).
+    """
+
+    PREFETCH_DEPTH = 2
 
     def __init__(self, trainer, loader, strategy):
         self._trainer = trainer
         self._strategy = strategy
         self._it = enumerate(loader)
+        self._buf: list = []            # pre-pulled items, transfers live
+        self._prefetch = (trainer.steps_per_execution == 1
+                          and jax.process_count() == 1
+                          and os.environ.get("RLT_STREAM_PREFETCH",
+                                             "1") != "0")
         self.exhausted = False
 
-    def take(self, n: int) -> list:
-        """Up to ``n`` acceptable batches, honoring ``limit_train_batches``
-        (which counts loader POSITIONS, not accepted batches — the
-        contract shared by every dispatch path)."""
+    def _pull(self) -> "Item | None":
+        """One acceptable batch from the loader, honoring
+        ``limit_train_batches`` (which counts loader POSITIONS, not
+        accepted batches — the contract shared by every dispatch path)."""
         t = self._trainer
-        out: list = []
-        while len(out) < n and not self.exhausted:
+        while not self.exhausted:
             try:
                 batch_idx, batch = next(self._it)
             except StopIteration:
                 self.exhausted = True
-                break
+                return None
             if t.limit_train_batches is not None \
                     and batch_idx >= t.limit_train_batches:
                 self.exhausted = True
-                break
+                return None
             if t._batch_ok(batch, self._strategy):
-                out.append(Item(batch_idx=batch_idx, kind="host",
-                                payload=batch))
+                return Item(batch_idx=batch_idx, kind="host",
+                            payload=batch)
+        return None
+
+    def _start_transfer(self, item: Item) -> None:
+        if item.device is not None:
+            return
+        t = self._trainer
+        host = t._host_cast(item.payload)
+        if t._mesh is not None and t._mesh.devices.size > 1:
+            sh = self._strategy.batch_shardings(t._mesh, host)
+            item.device = jax.device_put(host, sh)
+        else:
+            item.device = jax.device_put(host)
+
+    def take(self, n: int) -> list:
+        out: list = []
+        while len(out) < n:
+            item = self._buf.pop(0) if self._buf else self._pull()
+            if item is None:
+                break
+            out.append(item)
+        if self._prefetch:
+            for it in out:
+                self._start_transfer(it)
+            while len(self._buf) < self.PREFETCH_DEPTH:
+                nxt = self._pull()
+                if nxt is None:
+                    break
+                self._start_transfer(nxt)
+                self._buf.append(nxt)
         return out
 
     def chunkable(self, items: list) -> bool:
@@ -112,7 +161,10 @@ class StreamSource:
         return all(s == shapes[0] for s in shapes)
 
     def run_one(self, trainer, item: Item):
-        gbatch = trainer._put_batch(item.payload, self._strategy)
+        if item.device is not None:
+            gbatch = item.device
+        else:
+            gbatch = trainer._put_batch(item.payload, self._strategy)
         trainer.state, metrics = trainer._train_step(trainer.state, gbatch)
         return metrics
 
@@ -178,33 +230,91 @@ class CachedSource:
             return ds[ids]
         return self._loader.collate_fn([ds[int(i)] for i in ids])
 
+    @property
+    def _n_shards(self) -> int:
+        return max(1, getattr(self._loader, "num_shards", 1))
+
     def build(self) -> bool:
         """Upload all samples (dataset order) to device; False = unusable
         (caller streams instead; nothing has been consumed from the
-        loader — the cache reads the DATASET, not the iterator)."""
+        loader — the cache reads the DATASET, not the iterator).
+
+        Multi-process (the loader is a per-process shard clone): the
+        flat cache is ONE global array whose dim-0 sharding follows the
+        batch sharding — each process materializes only the sample rows
+        its devices own (``make_array_from_callback``), and the
+        per-epoch repack is a global SPMD gather whose all-to-all moves
+        samples wherever the epoch's membership needs them.  This is
+        what lets a shuffling loader re-draw CROSS-PROCESS batch
+        membership with the dataset resident on device — the round-2
+        cache simply refused to run distributed."""
         t = self._trainer
         loader = self._loader
         n = len(loader.dataset)
-        flat = self._gather_host(np.arange(n))
-        flat = t._host_cast(flat)
-        leaves = jax.tree_util.tree_leaves(flat)
-        if not leaves or any(x.shape[0] != n for x in leaves):
-            _log.warning(
-                "cache_train_dataset: collated dataset is not [N, ...]-"
-                "shaped; streaming instead.")
-            return False
-        shardings = self._flat_shardings(flat, n)
-        self._flat = jax.device_put(flat, shardings) \
-            if shardings is not None else jax.device_put(flat)
-        jax.block_until_ready(self._flat)
+        global_batch = loader.batch_size * self._n_shards
+        self._global_batch = global_batch
 
         def repack(flat_dev, perm):
-            nb = perm.shape[0] // loader.batch_size
+            nb = perm.shape[0] // global_batch
             g = jax.tree_util.tree_map(
                 lambda f: jnp.take(f, perm, axis=0), flat_dev)
             return jax.tree_util.tree_map(
-                lambda x: x.reshape((nb, loader.batch_size) + x.shape[1:]),
-                g)
+                lambda x: x.reshape((nb, global_batch) + x.shape[1:]), g)
+
+        if self._n_shards > 1:
+            dp = self._strategy.data_parallel_size(t._mesh)
+            if n % dp:
+                _log.warning(
+                    "cache_train_dataset: dataset size %d does not "
+                    "divide across %d data shards; streaming instead.",
+                    n, dp)
+                return False
+            # materialize per-leaf global arrays: the callback hands jax
+            # exactly the row range each local device owns.  Row chunks
+            # are memoized by range — jax asks once per (leaf, local
+            # device shard) and the gather/cast work should happen once
+            # per distinct range, not leaves × shards times.
+            sample = t._host_cast(self._gather_host(np.arange(1)))
+            shardings = self._strategy.batch_shardings(t._mesh, sample)
+            leaves, treedef = jax.tree_util.tree_flatten(sample)
+            shard_leaves = jax.tree_util.tree_leaves(shardings)
+            chunk_memo: dict = {}
+
+            def rows_chunk(start, stop):
+                got = chunk_memo.get((start, stop))
+                if got is None:
+                    got = chunk_memo[(start, stop)] = \
+                        jax.tree_util.tree_leaves(t._host_cast(
+                            self._gather_host(np.arange(start, stop))))
+                return got
+
+            out_leaves = []
+            for li, (leaf0, sh) in enumerate(zip(leaves, shard_leaves)):
+                shape = (n,) + leaf0.shape[1:]
+
+                def cb(idx, li=li):
+                    start = idx[0].start or 0
+                    stop = idx[0].stop if idx[0].stop is not None else n
+                    piece = rows_chunk(start, stop)[li]
+                    # apply any trailing-dim index components verbatim
+                    return piece[(slice(None),) + tuple(idx[1:])]
+
+                out_leaves.append(jax.make_array_from_callback(
+                    shape, sh, cb))
+            self._flat = jax.tree_util.tree_unflatten(treedef, out_leaves)
+            chunk_memo.clear()
+        else:
+            flat = t._host_cast(self._gather_host(np.arange(n)))
+            leaves = jax.tree_util.tree_leaves(flat)
+            if not leaves or any(x.shape[0] != n for x in leaves):
+                _log.warning(
+                    "cache_train_dataset: collated dataset is not "
+                    "[N, ...]-shaped; streaming instead.")
+                return False
+            shardings = self._flat_shardings(flat, n)
+            self._flat = jax.device_put(flat, shardings) \
+                if shardings is not None else jax.device_put(flat)
+        jax.block_until_ready(self._flat)
 
         kw = {}
         if t._stacked_batch_shardings is not None:
@@ -230,15 +340,55 @@ class CachedSource:
     def _epoch_indices(self) -> np.ndarray:
         return np.asarray(self._loader._indices())
 
+    def _epoch_plan(self):
+        """(perm, local_ids, nb, tail_local): the epoch's global repack
+        permutation, the per-batch LOCAL sample ids (this process's
+        portion — callback arguments and the host tail match what the
+        streamed loop would feed this rank), the full-batch count, and
+        the local tail ids.
+
+        Multi-process: every rank reconstructs the full (unsharded)
+        index order and re-derives each rank's strided shard exactly as
+        DataLoader.shard does, so all ranks compute the SAME global perm
+        and execute the same repack program in lockstep.  Row order
+        within a global batch groups ranks contiguously — a mean loss is
+        order-invariant, and each rank's callbacks see its own rows.
+        """
+        loader = self._loader
+        B = loader.batch_size
+        P = self._n_shards
+        if P == 1:
+            idx = self._epoch_indices()
+            nb = len(idx) // B
+            local = [idx[j * B:(j + 1) * B] for j in range(nb)]
+            perm_src = idx
+            tail = idx[nb * B:]
+            return perm_src[:nb * B], local, nb, tail
+        full = np.asarray(loader.shard(1, 0)._indices())
+        pad = (-len(full)) % P
+        if pad:
+            full = np.concatenate([full, full[:pad]])
+        per_rank = [full[r::P] for r in range(P)]
+        nb = len(per_rank[0]) // B
+        rank = getattr(loader, "shard_index", 0)
+        local = [per_rank[rank][j * B:(j + 1) * B] for j in range(nb)]
+        perm = np.concatenate([
+            np.concatenate([pr[j * B:(j + 1) * B] for pr in per_rank])
+            for j in range(nb)]) if nb else np.zeros((0,), np.int64)
+        tail = per_rank[rank][nb * B:]
+        return perm, local, nb, tail
+
     def new_epoch(self) -> "CachedSource":
         t = self._trainer
         loader = self._loader
-        idx = self._epoch_indices()
         B = loader.batch_size
-        nb = len(idx) // B
-        if t.limit_train_batches is not None:
-            nb = min(nb, t.limit_train_batches)
-        perm = idx[:nb * B].astype(np.int32)
+        perm, local_ids, nb, tail = self._epoch_plan()
+        if t.limit_train_batches is not None and \
+                nb > t.limit_train_batches:
+            nb = t.limit_train_batches
+            perm = perm[:nb * self._global_batch]
+            local_ids = local_ids[:nb]
+        perm = perm.astype(np.int32)
         if self._last_perm is None or not np.array_equal(
                 perm, self._last_perm):
             self._repacked = self._repack_jit(self._flat, perm)
@@ -268,14 +418,12 @@ class CachedSource:
 
         self._plan = [
             Item(batch_idx=j, kind="cached", payload=j,
-                 _batch_fn=(lambda j=j, s=idx[j * B:(j + 1) * B]:
+                 _batch_fn=(lambda j=j, s=local_ids[j]:
                             memo_batch(j, s)))
             for j in range(nb)]
-        tail = idx[nb * B:]
         under_limit = (t.limit_train_batches is None
                        or nb < t.limit_train_batches)
-        if len(tail) and not loader.drop_last and under_limit \
-                and nb * B == len(idx) - len(tail):
+        if len(tail) and not loader.drop_last and under_limit:
             tail_batch = batch_of(tail)
             if t._batch_ok(tail_batch, self._strategy):
                 self._plan.append(Item(batch_idx=nb, kind="host",

@@ -412,32 +412,31 @@ class Trainer:
                                        self._stacked_batch_shardings)
             self._multi_train_step = jax.jit(multi_step, **mkw)
         if self.cache_train_dataset:
-            if jax.process_count() > 1:
-                _log.warning(
-                    "cache_train_dataset is single-process only "
-                    "(multi-process batches are assembled per host); "
-                    "falling back to streamed batches.")
-            else:
-                def gather(dataset, i):
-                    return jax.tree_util.tree_map(
-                        lambda d: jax.lax.dynamic_index_in_dim(
-                            d, i, 0, keepdims=False), dataset)
+            # multi-process included: the cache is a global array (one
+            # shard per host's devices) and these programs are ordinary
+            # SPMD — every process dispatches them in lockstep exactly
+            # like the streamed train step (core/loop_engine.py
+            # CachedSource.build for the global-assembly details)
+            def gather(dataset, i):
+                return jax.tree_util.tree_map(
+                    lambda d: jax.lax.dynamic_index_in_dim(
+                        d, i, 0, keepdims=False), dataset)
 
-                def cached_multi(state, dataset, idxs):
-                    return jax.lax.scan(
-                        lambda s, i: step_fn(s, gather(dataset, i)),
-                        state, idxs)
+            def cached_multi(state, dataset, idxs):
+                return jax.lax.scan(
+                    lambda s, i: step_fn(s, gather(dataset, i)),
+                    state, idxs)
 
-                def cached_single(state, dataset, i):
-                    return step_fn(state, gather(dataset, i))
+            def cached_single(state, dataset, i):
+                return step_fn(state, gather(dataset, i))
 
-                ckw = dict(donate_argnums=0,
-                           out_shardings=(shardings, None))
-                if self._stacked_batch_shardings is not None:
-                    ckw["in_shardings"] = (
-                        shardings, self._stacked_batch_shardings, None)
-                self._cached_multi_step = jax.jit(cached_multi, **ckw)
-                self._cached_single_step = jax.jit(cached_single, **ckw)
+            ckw = dict(donate_argnums=0,
+                       out_shardings=(shardings, None))
+            if self._stacked_batch_shardings is not None:
+                ckw["in_shardings"] = (
+                    shardings, self._stacked_batch_shardings, None)
+            self._cached_multi_step = jax.jit(cached_multi, **ckw)
+            self._cached_single_step = jax.jit(cached_single, **ckw)
         self._eval_steps = {
             s: _ShardedStepCache(build_eval_step(module, s), self, strategy)
             for s in ("validate", "test")}

@@ -239,3 +239,36 @@ def test_checkpoint_equals_trained_weights(tmp_path, seed):
     for a, b in zip(np.asarray(list(saved.values())[0]["kernel"]).ravel()[:3],
                     np.asarray(list(trained.values())[0]["kernel"]).ravel()[:3]):
         np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_cached_dataset_across_actors(tmp_path, seed):
+    """cache_train_dataset under a multi-process mesh (VERDICT r2 #4):
+    the flat cache is ONE global array (each worker materializes its
+    devices' sample rows), the per-epoch repack is a global SPMD
+    gather, and the cached step programs dispatch in lockstep.  The
+    run must match the streamed actor run exactly — same steps, same
+    final loss."""
+    def run(cache):
+        trainer = get_trainer(str(tmp_path / f"c{cache}"),
+                              plugins=[cpu_plugin(2)], max_epochs=2,
+                              limit_train_batches=8, checkpoint=False,
+                              cache_train_dataset=cache, seed=0)
+        trainer.fit(BoringModel(batch_size=8, dataset_length=128))
+        assert trainer.global_step == 16
+        return float(trainer.callback_metrics["loss"])
+
+    streamed = run(False)
+    cached = run(True)
+    assert abs(cached - streamed) <= 1e-5 * max(1.0, abs(streamed)), \
+        f"cached {cached} != streamed {streamed}"
+
+
+def test_cached_chunked_across_actors(tmp_path, seed):
+    """cache + steps_per_execution together across actors — the cached
+    multi-step scan with a globally sharded device dataset."""
+    trainer = get_trainer(str(tmp_path), plugins=[cpu_plugin(2)],
+                          max_epochs=1, limit_train_batches=8,
+                          checkpoint=False, steps_per_execution=4,
+                          cache_train_dataset=True, seed=0)
+    train_test(trainer, BoringModel(batch_size=8, dataset_length=128))
+    assert trainer.global_step == 8
