@@ -1,0 +1,58 @@
+"""Long-context throughput: tokens/sec/chip at T = 2k / 4k / 8k.
+
+The long-sequence story is first-class (SURVEY aux: ring attention +
+flash kernels + chunked CE); this bench pins single-chip numbers for
+it: a gpt2-small-width decoder at growing T with the levers the config
+system flips at scale — triangular-grid causal flash kernels (default
+where they engage, T>=2048), remat, and chunked CE (T=8k).  Ring
+attention distributes T over a `sequence` mesh axis on real pods; its
+equality tests run on the virtual mesh (tests/test_ring_attention.py).
+
+    python -m benchmarks.bench_longcontext [2048 4096 8192]
+
+Prints one JSON line per sequence length (tokens/sec = steps/sec × B·T).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+
+import jax
+
+from benchmarks.harness import run_steps_per_sec
+from ray_lightning_tpu.models.gpt import CONFIGS, GPTLightningModule
+
+# first-measurement baselines (v5e chip, round 3) so later rounds diff
+BASELINES = {2048: 74_359.0, 4096: 57_500.0, 8192: 36_839.0}
+
+
+def main() -> None:
+    platform = jax.devices()[0].platform
+    lengths = [int(a) for a in sys.argv[1:]] or [2048, 4096, 8192]
+    for t in lengths:
+        if platform == "cpu":
+            cfg = dataclasses.replace(CONFIGS["tiny"], block_size=256)
+            batch = 2
+        else:
+            # gpt2-small width; remat + (at 8k) chunked CE keep HBM sane,
+            # batch shrinks with T to hold the token budget steady
+            batch = max(1, 8192 // t)
+            cfg = dataclasses.replace(
+                CONFIGS["gpt2-small"], block_size=t, remat=True,
+                chunked_ce=16 if t >= 8192 else 0)
+        module = GPTLightningModule(cfg, dataset_size=batch * 16,
+                                    batch_size=batch)
+        res = run_steps_per_sec(
+            module, f"gpt2s_T{t}_steps_per_sec_{platform}",
+            warmup=2, timed=8)
+        toks = res["value"] * batch * t
+        base = BASELINES.get(t)
+        print(__import__("json").dumps({
+            "metric": f"gpt2s_T{t}_tokens_per_sec_{platform}",
+            "value": round(toks, 0), "unit": "tokens/sec",
+            "vs_baseline": round(toks / base, 3) if base else 1.0}))
+
+
+if __name__ == "__main__":
+    main()
