@@ -154,6 +154,14 @@ class LightningModule:
     #: optimizer state preserves update precision.
     param_dtype = None
 
+    #: Set False when ``training_step`` consumes no randomness (no
+    #: dropout / ``ctx.make_rng``): the compiled train step then skips
+    #: the per-step PRNG split+fold — scalar-core work that dominates
+    #: microsecond-scale models.  Leave True (the safe default) for any
+    #: stochastic module; a False-declaring module that calls
+    #: ``ctx.make_rng`` raises at trace time.
+    uses_rng = True
+
     def __init__(self):
         self.trainer = None
         self.model = None

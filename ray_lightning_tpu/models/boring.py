@@ -41,6 +41,8 @@ class _Linear(nn.Module):
 class BoringModel(LightningModule):
     """Minimal end-to-end module (tests/utils.py:28-96 analog)."""
 
+    uses_rng = False    # deterministic linear model
+
     def __init__(self, lr: float = 0.1, dataset_length: int = 64,
                  batch_size: int = 2):
         super().__init__()
@@ -121,6 +123,8 @@ def synthetic_mnist(n: int, seed: int = 0) -> ArrayDataset:
 
 class LightningMNISTClassifier(LightningModule):
     """3-layer MLP classifier (tests/utils.py:99-148 analog)."""
+
+    uses_rng = False    # no dropout: the step skips per-step PRNG work
 
     def __init__(self, config: Optional[dict] = None, data_dir: str = "",
                  train_size: int = 512, val_size: int = 128):
