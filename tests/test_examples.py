@@ -17,6 +17,7 @@ EXAMPLES = [
     "ray_lightning_tpu.examples.ray_longcontext_example",
     "ray_lightning_tpu.examples.ray_moe_example",
     "ray_lightning_tpu.examples.ray_pipeline_example",
+    "ray_lightning_tpu.examples.ray_perf_tuning_example",
 ]
 
 
