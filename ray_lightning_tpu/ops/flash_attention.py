@@ -262,6 +262,96 @@ def _bwd_packed_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dq_ref[0, :, sl] = dq.astype(dq_ref.dtype)
 
 
+def _fwd_tri_packed_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                           acc_ref, m_ref, l_ref,
+                           *, sm_scale, block, d, pack):
+    """Triangular-grid forward on head-packed [B, T, C] blocks: the
+    online-softmax math of ``_fwd_tri_kernel`` looped over the packed
+    heads, with per-head scratch planes (``acc_ref[j]`` etc.)."""
+    qi, kb = _tri_decode(pl.program_id(1))
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+    for j in range(pack):
+        sl = slice(j * d, (j + 1) * d)
+        q = q_ref[0][:, sl]
+        k = k_ref[0][:, sl]
+        v = v_ref[0][:, sl]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.where((kb == qi) & (rows < cols), NEG_INF, s)
+        m_prev = m_ref[j]
+        s_max = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, s_max)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        l_ref[j] = alpha * l_ref[j] + jnp.sum(p, -1, keepdims=True)
+        acc_ref[j] = acc_ref[j] * alpha[:, :1] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[j] = m_new
+
+    @pl.when(kb == qi)
+    def _final():
+        for j in range(pack):
+            sl = slice(j * d, (j + 1) * d)
+            l = l_ref[j][:, :1]
+            o_ref[0, :, sl] = (acc_ref[j] / l).astype(o_ref.dtype)
+            lse_ref[0, 0, :, j:j + 1] = m_ref[j][:, :1] + jnp.log(l)
+
+
+def _fwd_tri_packed(q, k, v, h, sm_scale, bq, nq, interpret):
+    b, t, c = q.shape
+    d = c // h
+    pack = _head_pack(d, h)
+    g2 = h // pack
+    w = pack * d
+    n_tri = nq * (nq + 1) // 2
+    kernel = functools.partial(_fwd_tri_packed_kernel, sm_scale=sm_scale,
+                               block=bq, d=d, pack=pack)
+
+    def q_map(g, i):
+        return (g // g2, _tri_decode(i)[0], g % g2)
+
+    def k_map(g, i):
+        return (g // g2, _tri_decode(i)[1], g % g2)
+
+    def r_map(g, i):
+        return (g // g2, g % g2, _tri_decode(i)[0], 0)
+
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(b * g2, n_tri),
+        in_specs=[
+            pl.BlockSpec((1, bq, w), q_map),
+            pl.BlockSpec((1, bq, w), k_map),
+            pl.BlockSpec((1, bq, w), k_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, w), q_map),
+            pl.BlockSpec((1, 1, bq, pack), r_map),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t, c), q.dtype),
+            jax.ShapeDtypeStruct((b, g2, t, pack), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((pack, bq, d), jnp.float32),
+            pltpu.VMEM((pack, bq, 128), jnp.float32),
+            pltpu.VMEM((pack, bq, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
 def _fwd_packed(q, k, v, h, causal, sm_scale, interpret):
     b, t, c = q.shape
     d = c // h
@@ -286,6 +376,170 @@ def _fwd_packed(q, k, v, h, causal, sm_scale, interpret):
         interpret=interpret,
     )(q, k, v)
     return o, lse
+
+
+def _bwd_dkdv_tri_packed_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                                *, sm_scale, block, d, pack, n):
+    """Triangular dk/dv on head-packed blocks (``_bwd_dkdv_tri_kernel``
+    math looped over packed heads; per-head scratch planes)."""
+    ki, qi = _tri_decode_rev(pl.program_id(1), n)
+
+    @pl.when(qi == n - 1)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+    for j in range(pack):
+        sl = slice(j * d, (j + 1) * d)
+        q = q_ref[0][:, sl]
+        k = k_ref[0][:, sl]
+        v = v_ref[0][:, sl]
+        do = do_ref[0][:, sl]
+        lse = lse_ref[0, 0][:, j:j + 1]
+        delta = delta_ref[0, 0][:, j:j + 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.where((qi == ki) & (rows < cols), NEG_INF, s)
+        p = jnp.exp(s - lse)
+        dv_acc[j] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_acc[j] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == ki)
+    def _final():
+        for j in range(pack):
+            sl = slice(j * d, (j + 1) * d)
+            dk_ref[0, :, sl] = (dk_acc[j] * sm_scale).astype(dk_ref.dtype)
+            dv_ref[0, :, sl] = dv_acc[j].astype(dv_ref.dtype)
+
+
+def _bwd_dq_tri_packed_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                              delta_ref, dq_ref, dq_acc,
+                              *, sm_scale, block, d, pack):
+    qi, kb = _tri_decode(pl.program_id(1))
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+    for j in range(pack):
+        sl = slice(j * d, (j + 1) * d)
+        q = q_ref[0][:, sl]
+        k = k_ref[0][:, sl]
+        v = v_ref[0][:, sl]
+        do = do_ref[0][:, sl]
+        lse = lse_ref[0, 0][:, j:j + 1]
+        delta = delta_ref[0, 0][:, j:j + 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.where((kb == qi) & (rows < cols), NEG_INF, s)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_acc[j] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == qi)
+    def _final():
+        for j in range(pack):
+            sl = slice(j * d, (j + 1) * d)
+            dq_ref[0, :, sl] = (dq_acc[j] * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_tri_packed(q, k, v, h, lse, do, delta, sm_scale, bq, nq,
+                    interpret):
+    """Head-packed triangular backward on [B, T, C]; ``delta`` arrives
+    in the packed lse layout [B, H/pack, T, pack]."""
+    b, t, c = q.shape
+    d = c // h
+    pack = _head_pack(d, h)
+    g2 = h // pack
+    w = pack * d
+    n_tri = nq * (nq + 1) // 2
+
+    def ki_map(g, i):
+        return (g // g2, _tri_decode_rev(i, nq)[0], g % g2)
+
+    def qi_rev_map(g, i):
+        return (g // g2, _tri_decode_rev(i, nq)[1], g % g2)
+
+    def r_rev_map(g, i):
+        return (g // g2, g % g2, _tri_decode_rev(i, nq)[1], 0)
+
+    dkdv = functools.partial(_bwd_dkdv_tri_packed_kernel,
+                             sm_scale=sm_scale, block=bq, d=d, pack=pack,
+                             n=nq)
+    dk, dv = pl.pallas_call(
+        dkdv,
+        grid=(b * g2, n_tri),
+        in_specs=[
+            pl.BlockSpec((1, bq, w), qi_rev_map),               # q
+            pl.BlockSpec((1, bq, w), ki_map),                   # k
+            pl.BlockSpec((1, bq, w), ki_map),                   # v
+            pl.BlockSpec((1, bq, w), qi_rev_map),               # do
+            pl.BlockSpec((1, 1, bq, pack), r_rev_map),          # lse
+            pl.BlockSpec((1, 1, bq, pack), r_rev_map),          # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, w), ki_map),
+            pl.BlockSpec((1, bq, w), ki_map),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t, c), k.dtype),
+            jax.ShapeDtypeStruct((b, t, c), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((pack, bq, d), jnp.float32),
+            pltpu.VMEM((pack, bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    def q_map(g, i):
+        return (g // g2, _tri_decode(i)[0], g % g2)
+
+    def k_map(g, i):
+        return (g // g2, _tri_decode(i)[1], g % g2)
+
+    def r_map(g, i):
+        return (g // g2, g % g2, _tri_decode(i)[0], 0)
+
+    dqk = functools.partial(_bwd_dq_tri_packed_kernel, sm_scale=sm_scale,
+                            block=bq, d=d, pack=pack)
+    dq = pl.pallas_call(
+        dqk,
+        grid=(b * g2, n_tri),
+        in_specs=[
+            pl.BlockSpec((1, bq, w), q_map),
+            pl.BlockSpec((1, bq, w), k_map),
+            pl.BlockSpec((1, bq, w), k_map),
+            pl.BlockSpec((1, bq, w), q_map),
+            pl.BlockSpec((1, 1, bq, pack), r_map),
+            pl.BlockSpec((1, 1, bq, pack), r_map),
+        ],
+        out_specs=pl.BlockSpec((1, bq, w), q_map),
+        out_shape=jax.ShapeDtypeStruct((b, t, c), q.dtype),
+        scratch_shapes=[pltpu.VMEM((pack, bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
 
 
 def _bwd_packed(q, k, v, h, o, lse, do, causal, sm_scale, interpret):
@@ -340,6 +594,9 @@ def _fwd(q, k, v, h, causal, sm_scale, block_q, block_k, interpret):
 
     if nq == 1 and nk == 1 and _head_pack(d, h):
         return _fwd_packed(q, k, v, h, causal, sm_scale, interpret)
+
+    if _use_tri(causal, bq, bk, nq) and _head_pack(d, h):
+        return _fwd_tri_packed(q, k, v, h, sm_scale, bq, nq, interpret)
 
     q, k, v = (_fold(x, b, t, h, d) for x in (q, k, v))
 
@@ -741,6 +998,16 @@ def _bwd(q, k, v, h, o, lse, do, causal, sm_scale, block_q, block_k,
     if nq == 1 and nk == 1 and _head_pack(d, h):
         return _bwd_packed(q, k, v, h, o, lse, do, causal, sm_scale,
                            interpret)
+
+    if _use_tri(causal, bq, bk, nq) and _head_pack(d, h):
+        # per-head delta in the packed lse layout [B, H/pack, T, pack]
+        pack = _head_pack(d, h)
+        delta = jnp.sum((do.astype(jnp.float32)
+                         * o.astype(jnp.float32)).reshape(b, t, h, d),
+                        axis=-1)
+        delta = delta.reshape(b, t, h // pack, pack).transpose(0, 2, 1, 3)
+        return _bwd_tri_packed(q, k, v, h, lse, do, delta, sm_scale, bq,
+                               nq, interpret)
 
     q, k, v, o, do = (_fold(x, b, t, h, d) for x in (q, k, v, o, do))
 
