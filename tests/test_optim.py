@@ -110,3 +110,37 @@ def test_gpt_bf16_resident_matches_fp32_trajectory(monkeypatch):
     assert np.isfinite(final16)
     # same objective, same data: the trajectories agree to bf16 noise
     assert abs(final16 - final32) < 0.15 * max(1.0, abs(final32))
+
+
+def test_master_copy_shards_under_zero1(seed):
+    """The fp32 master inside FP32MasterState must shard across the
+    data axis under Zero1Strategy — the FairScale-OSS move (each rank
+    owns a slice of the full-precision weights) expressed as a sharding
+    annotation.  Its pytree path embeds the param path, so the
+    strategies' opt-state rules apply to it like any optax state."""
+    from ray_lightning_tpu.core.trainer import Trainer
+    from ray_lightning_tpu.models.gpt import GPTLightningModule
+
+    model = GPTLightningModule("tiny", dataset_size=32, batch_size=8)
+    trainer = Trainer(max_steps=1, max_epochs=1, strategy="zero1",
+                      enable_checkpointing=False, num_sanity_val_steps=0,
+                      limit_val_batches=0, seed=0)
+    trainer.fit(model)
+
+    masters = trainer.state.opt_state.master
+    leaves = jax.tree_util.tree_leaves(masters)
+    assert leaves, "no master copy in optimizer state"
+    sharded = [x for x in leaves
+               if x.ndim > 0 and x.size > 1
+               and any(s is not None for s in x.sharding.spec)]
+    assert sharded, (
+        "zero1 left every fp32 master replicated: "
+        + str({tuple(x.shape): str(x.sharding) for x in leaves[:4]}))
+    for x in leaves:
+        assert x.dtype == jnp.float32
+    # and the resident params stayed replicated low-precision (ZeRO-1
+    # shards OPTIMIZER state, not params)
+    p_leaves = jax.tree_util.tree_leaves(trainer.state.params)
+    assert all(pl.dtype == jnp.bfloat16 for pl in p_leaves)
+    assert all(not any(s is not None for s in pl.sharding.spec)
+               for pl in p_leaves if pl.ndim > 0)
