@@ -3,7 +3,13 @@ path (Trainer → compiled SPMD train step) on whatever accelerator is
 attached (one TPU chip under the driver; CPU elsewhere).
 
 Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": "steps/sec", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "steps/sec", "vs_baseline": N,
+   "device_ms": M}
+
+``value`` is wall steps/sec (the BASELINE.md bar as specified);
+``device_ms`` is the median device time of the compiled train step
+from a warm-tail trace — the tunnel-immune number: wall swings ±3-5%
+with host-link state (VERDICT r3 weak #1), device time repeats to <1%.
 
 The reference publishes no numbers (BASELINE.md); ``vs_baseline`` is
 measured against the stored first-round value below so rounds are
@@ -47,11 +53,13 @@ def main() -> None:
         cfg, batch = CONFIGS["gpt2-small"], 8
         metric = f"gpt2s_train_steps_per_sec_{platform}"
 
+    trace_steps = 8
     module = GPTLightningModule(
-        cfg, dataset_size=batch * (WARMUP_STEPS + TIMED_STEPS),
+        cfg, dataset_size=batch * (WARMUP_STEPS + TIMED_STEPS + trace_steps),
         batch_size=batch)
     run_steps_per_sec(module, metric, warmup=WARMUP_STEPS,
-                      timed=TIMED_STEPS, baseline=BASELINES.get(metric))
+                      timed=TIMED_STEPS, baseline=BASELINES.get(metric),
+                      trace_steps=trace_steps, inline_device_ms=True)
 
 
 if __name__ == "__main__":

@@ -92,18 +92,15 @@ def _emit_device_ms(run, side: str) -> "float | None":
     median device ms/step — the tunnel-immune counterpart of the wall
     steps/sec, captured AFTER the timed window so tracing overhead never
     contaminates the wall figure."""
-    import shutil
-    d = None
+    from benchmarks import trace_tools
     try:
-        from benchmarks import trace_tools
         d = trace_tools.capture_trace(run)
-        _, med, cnt = trace_tools.dominant_module(d)
     except Exception as e:  # profiler unavailable on some backends
         sys.stderr.write(f"device-time capture skipped: {e}\n")
         return None
-    finally:
-        if d:
-            shutil.rmtree(d, ignore_errors=True)
+    med = trace_tools.dominant_module_ms_or_none(d)
+    if med is None:
+        return None
     _emit(f"{_CURRENT_WORKLOAD}_{side}_device_ms", med, unit="ms/step")
     return med
 
@@ -121,18 +118,10 @@ def _emit_framework_device(result: dict) -> "float | None":
     with ``trace_steps`` (the trace covers WARM steps of the same fit
     the wall clock measured — a fresh Trainer would recompile inside
     the trace window and the tunnel profiler would drop the events)."""
-    import shutil
-    d = result.get("trace_dir")
-    if not d:
+    from benchmarks import trace_tools
+    med = trace_tools.dominant_module_ms_or_none(result.get("trace_dir"))
+    if med is None:
         return None
-    try:
-        from benchmarks import trace_tools
-        _, med, _ = trace_tools.dominant_module(d)
-    except Exception as e:
-        sys.stderr.write(f"device-time parse skipped: {e}\n")
-        return None
-    finally:
-        shutil.rmtree(d, ignore_errors=True)
     _emit(f"{_CURRENT_WORKLOAD}_framework_device_ms", med, unit="ms/step")
     return med
 

@@ -20,7 +20,8 @@ import numpy as np
 def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
                       timed: int = 30, baseline: "float | None" = None,
                       strategy=None, trainer_kwargs=None,
-                      trace_steps: int = 0) -> dict:
+                      trace_steps: int = 0,
+                      inline_device_ms: bool = False) -> dict:
     """Time steady-state steps; optionally profile a WARM tail.
 
     ``trace_steps > 0``: after the timed window closes (and its sync
@@ -29,6 +30,12 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
     records the step executions (tracing a fresh Trainer recompiles
     inside the window and the device events never materialize).  The
     result dict then carries ``trace_dir``.
+
+    ``inline_device_ms``: fold the dominant XLA module's median device
+    ms/step (from the warm-tail trace) into the ONE printed JSON line
+    as ``device_ms`` — the tunnel-immune number of record alongside the
+    wall steps/sec, which swings ±3-5% with host-link state that has
+    nothing to do with the framework.  The trace dir is consumed.
     """
     from ray_lightning_tpu import Trainer
     from ray_lightning_tpu.core.callbacks import Callback
@@ -104,6 +111,12 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
         "unit": "steps/sec",
         "vs_baseline": round(steps_per_sec / (baseline or steps_per_sec), 3),
     }
+    if inline_device_ms and timer.trace_dir is not None:
+        from benchmarks import trace_tools
+        med = trace_tools.dominant_module_ms_or_none(timer.trace_dir)
+        timer.trace_dir = None
+        if med is not None:
+            result["device_ms"] = round(med, 2)
     print(json.dumps(result))
     if timer.trace_dir is not None:
         result["trace_dir"] = timer.trace_dir

@@ -191,6 +191,76 @@ def _use_tri(causal: bool, bq: int, bk: int, nq: int) -> bool:
             and os.environ.get("RLT_FLASH_TRI", "1") != "0")
 
 
+def _sub_block(t: int, causal: bool) -> int:
+    """Causal staircase sub-block size for the single-block kernels
+    (0 = no subtiling).
+
+    A causal single-block kernel that computes the full [T, T] score
+    matrix wastes half its MXU work on positions the mask throws away.
+    Splitting the q rows into T/sub row-blocks and contracting each only
+    against k[:row_end] keeps the staircase of valid blocks and skips
+    the rest — at sub = T/4 that is 37.5% of the score-matrix FLOPs,
+    with ZERO grid overhead because the loop unrolls statically inside
+    the kernel (unlike the round-2 512×512 *grid* tiles, which lost to
+    the single block on per-block prefetch + pl.when dead iterations).
+    ``RLT_FLASH_SUB`` overrides (0 disables).
+    """
+    if not causal:
+        return 0
+    env = os.environ.get("RLT_FLASH_SUB")
+    if env:   # empty string falls through to the default (cf. RLT_FLASH_BLOCK_Q)
+        s = int(env)
+        return s if s > 0 and t % s == 0 and s < t else 0
+    return 256 if t % 256 == 0 and t >= 512 else 0
+
+
+def _staircase_fold(sm_scale: float) -> bool:
+    """Fold sm_scale into q when it is an exact power of two (1/√64 =
+    1/8 for the d=64 model family): a [T, D] multiply instead of
+    per-row [sub, u] score scaling, exact in bf16 because it only
+    shifts the exponent."""
+    return math.frexp(sm_scale)[0] == 0.5
+
+
+def _staircase_slab(qs, k, r0, u, *, sm_scale, fold):
+    """Masked fp32 score slab [sub, u] for staircase row-block
+    [r0, u): the ONE place the fold/scale/mask recipe lives, shared by
+    the forward and backward staircase so they cannot diverge (``qs``
+    is pre-scaled iff ``fold``)."""
+    s = jax.lax.dot_general(
+        qs[r0:u], k[:u], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if not fold:
+        s = s * sm_scale
+    rows = jax.lax.broadcasted_iota(jnp.int32, (u - r0, u), 0) + r0
+    cols = jax.lax.broadcasted_iota(jnp.int32, (u - r0, u), 1)
+    return jnp.where(rows >= cols, s, NEG_INF)
+
+
+def _staircase_fwd_math(q, k, v, *, sm_scale, block, sub):
+    """Causal single-block forward over staircase row-blocks.
+
+    Each row-block sees its complete (causally valid) score row, so a
+    plain max-shifted softmax applies — no online rescaling.  Returns
+    (o fp32 [T, D], lse fp32 [T, 1]).
+    """
+    fold = _staircase_fold(sm_scale)
+    qs = q * sm_scale if fold else q
+    n = block // sub
+    o_rows, lse_rows = [], []
+    for qi in range(n):
+        r0, u = qi * sub, (qi + 1) * sub
+        s = _staircase_slab(qs, k, r0, u, sm_scale=sm_scale, fold=fold)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        o_rows.append(jax.lax.dot_general(
+            p.astype(v.dtype), v[:u], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) / l)
+        lse_rows.append(m + jnp.log(l))
+    return jnp.concatenate(o_rows), jnp.concatenate(lse_rows)
+
+
 # -- head-packed single-block kernels (transpose-free fast path) ------------
 #
 # Mosaic requires a block's last dim to be a 128 multiple (or span the
@@ -220,11 +290,18 @@ def _head_pack(d: int, h: int) -> int:
 
 def _fwd_packed_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                        *, sm_scale, causal, block, d, pack):
+    sub = _sub_block(block, causal)
     for j in range(pack):
         sl = slice(j * d, (j + 1) * d)
         q = q_ref[0][:, sl]
         k = k_ref[0][:, sl]
         v = v_ref[0][:, sl]
+        if sub:
+            o, lse = _staircase_fwd_math(q, k, v, sm_scale=sm_scale,
+                                         block=block, sub=sub)
+            o_ref[0, :, sl] = o.astype(o_ref.dtype)
+            lse_ref[0, 0, :, j:j + 1] = lse
+            continue
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale      # [T, T]
@@ -781,7 +858,16 @@ def _single_block_bwd_math(q, k, v, do, lse, delta, *, sm_scale, causal,
     (s and dp recomputed in the dQ kernel): 7 MXU passes where 5
     suffice.  At the T=1024 headline that is ~29% of the backward FLOPs
     for free.  Same math, same dtypes, same order as the split kernels.
+
+    Causal blocks additionally take the staircase path (:func:`_sub_block`):
+    row-blocks of q contract only against k[:row_end], skipping the MXU
+    work the mask would zero — 37.5% of the [T,T]-matmul FLOPs at
+    sub = T/4, statically unrolled so there is no grid overhead.
     """
+    sub = _sub_block(block, causal)
+    if sub:
+        return _staircase_bwd_math(q, k, v, do, lse, delta,
+                                   sm_scale=sm_scale, block=block, sub=sub)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale          # [T, T]
@@ -805,6 +891,57 @@ def _single_block_bwd_math(q, k, v, do, lse, delta, *, sm_scale, causal,
         dsc, k, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale
     return dq, dk, dv
+
+
+def _staircase_bwd_math(q, k, v, do, lse, delta, *, sm_scale, block, sub):
+    """Causal single-block backward over staircase row-blocks.
+
+    Row-block qi computes its [sub, u] score slab (u = row_end) and the
+    five matmuls of :func:`_single_block_bwd_math` restricted to it;
+    dq rows finalize per row-block, dk/dv accumulate into fp32 [T, D]
+    buffers via static-slice adds.  ``sm_scale`` folds into q when it
+    is an exact power of two (s and dk then come pre-scaled: dk =
+    dSᵀ·(α·q)); dq post-scales its [sub, D] output either way — cheaper
+    than scaling [sub, u] score slabs.
+    """
+    fold = _staircase_fold(sm_scale)
+    qs = q * sm_scale if fold else q
+    n = block // sub
+    dq_rows = []
+    # per-column-block accumulators (static slices only: Pallas kernels
+    # cannot scatter into traced arrays)
+    dk_blocks: list = [None] * n
+    dv_blocks: list = [None] * n
+    for qi in range(n):
+        r0, u = qi * sub, (qi + 1) * sub
+        qr = qs[r0:u]
+        dor = do[r0:u]
+        s = _staircase_slab(qs, k, r0, u, sm_scale=sm_scale, fold=fold)
+        p = jnp.exp(s - lse[r0:u])
+        dv_c = jax.lax.dot_general(
+            p.astype(dor.dtype), dor, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # [u, d]
+        dp = jax.lax.dot_general(
+            dor, v[:u], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[r0:u])
+        dsc = ds.astype(q.dtype)
+        dk_c = jax.lax.dot_general(
+            dsc, qr, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # [u, d]
+        dq_rows.append(jax.lax.dot_general(
+            dsc, k[:u], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale)
+        for kb in range(qi + 1):
+            c = slice(kb * sub, (kb + 1) * sub)
+            dk_blocks[kb] = dk_c[c] if dk_blocks[kb] is None \
+                else dk_blocks[kb] + dk_c[c]
+            dv_blocks[kb] = dv_c[c] if dv_blocks[kb] is None \
+                else dv_blocks[kb] + dv_c[c]
+    dk = jnp.concatenate(dk_blocks)
+    if not fold:
+        dk = dk * sm_scale
+    return jnp.concatenate(dq_rows), dk, jnp.concatenate(dv_blocks)
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,

@@ -200,3 +200,75 @@ def test_packed_triangular_multiblock(h, d):
     for a, b, name in zip(g_flash, g_ref, "qkv"):
         np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5,
                                    err_msg=f"d{name} mismatch")
+
+
+# -- causal staircase subtiling (the round-4 single-block fast path) --------
+#
+# _sub_block auto-engages at T>=512 (the production headline runs
+# T=1024, sub=256); these tests force small sub sizes via RLT_FLASH_SUB
+# so the staircase math is pinned at CI-friendly shapes, and one case
+# pins the auto default at its threshold.
+
+
+# (2,64)/(3,64): packed/folded with the sm_scale fold (1/8 is a power
+# of two); (4,32): packed WITHOUT the fold (1/√32 has a non-trivial
+# mantissa) so the `not fold` scaling branches are covered too.
+@pytest.mark.parametrize("h,d", [(2, 64), (3, 64), (4, 32)])
+def test_staircase_single_block_matches_full(h, d, monkeypatch):
+    """Staircase on (sub=32 at T=128) must match staircase off bit-for-
+    bit on dq/dv and to fp tolerance elsewhere, and match the XLA
+    reference — for BOTH the head-packed and the folded fused kernels."""
+    from ray_lightning_tpu.ops.flash_attention import _sub_block
+    q, k, v = _rand_qkv(t=128, h=h, d=d)
+
+    def loss(attn):
+        def f(q, k, v):
+            o = attn(q, k, v)
+            return jnp.sum(jnp.sin(o))
+        return f
+
+    flash = loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, dtype=jnp.float32))
+    ref = loss(lambda q, k, v: dot_product_attention(
+        q, k, v, causal=True, dtype=jnp.float32))
+
+    monkeypatch.setenv("RLT_FLASH_SUB", "0")
+    assert _sub_block(128, True) == 0
+    v_off = flash(q, k, v)
+    g_off = jax.grad(flash, argnums=(0, 1, 2))(q, k, v)
+
+    monkeypatch.setenv("RLT_FLASH_SUB", "32")
+    assert _sub_block(128, True) == 32
+    v_on = flash(q, k, v)
+    g_on = jax.grad(flash, argnums=(0, 1, 2))(q, k, v)
+
+    np.testing.assert_allclose(v_on, v_off, atol=1e-5, rtol=1e-5)
+    for a, b, name in zip(g_on, g_off, "qkv"):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5,
+                                   err_msg=f"d{name} staircase vs full")
+    g_ref = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_on, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name} staircase vs ref")
+
+
+def test_staircase_auto_threshold(monkeypatch):
+    """The auto default: off below T=512, sub=256 at T in [512, 1024]
+    (single-block territory), irrelevant past 1024 where the tiled tri
+    grid takes over — and off for non-causal always."""
+    from ray_lightning_tpu.ops.flash_attention import _sub_block
+    monkeypatch.delenv("RLT_FLASH_SUB", raising=False)
+    assert _sub_block(128, True) == 0
+    assert _sub_block(256, True) == 0
+    assert _sub_block(512, True) == 256
+    assert _sub_block(1024, True) == 256
+    assert _sub_block(1024, False) == 0
+
+
+def test_staircase_non_causal_unaffected(monkeypatch):
+    """Non-causal single block must ignore RLT_FLASH_SUB entirely."""
+    monkeypatch.setenv("RLT_FLASH_SUB", "32")
+    q, k, v = _rand_qkv(t=128, h=2, d=64)
+    out = flash_attention(q, k, v, causal=False, dtype=jnp.float32)
+    ref = dot_product_attention(q, k, v, causal=False, dtype=jnp.float32)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
