@@ -45,6 +45,8 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
         (steps_per_execution>1: global_step advances k at a time) is
         timed correctly."""
 
+        needs_batch = False   # reads metrics/step only, never the batch
+
         def __init__(self):
             self.t0 = None
             self.start_step = None

@@ -19,6 +19,13 @@ import numpy as np
 class Callback:
     """Base callback; hooks mirror the PL names the reference relies on."""
 
+    #: Set False when the callback's per-batch hooks never read their
+    #: ``batch`` argument: the engine then skips host-collating cached
+    #: batches for it and passes ``batch=None`` (per-step host work is
+    #: exactly what the device-resident cache exists to remove).  Leave
+    #: True (the safe default) for any callback that looks at the batch.
+    needs_batch = True
+
     def setup(self, trainer, module, stage: str) -> None: ...
     def teardown(self, trainer, module, stage: str) -> None: ...
     def on_fit_start(self, trainer, module) -> None: ...
@@ -202,6 +209,8 @@ class ShardedCheckpoint(Callback):
     def _save(self, trainer) -> None:
         trainer.save_sharded_checkpoint(self.dirpath,
                                         max_to_keep=self.max_to_keep)
+
+    needs_batch = False    # step-cadence only; never reads the batch
 
     def on_train_batch_end(self, trainer, module, outputs, batch,
                            batch_idx) -> None:

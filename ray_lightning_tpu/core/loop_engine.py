@@ -54,8 +54,10 @@ class Item:
     into the source's repacked device cache (``kind="cached"``).
     ``device`` carries an in-flight device transfer when the stream
     source prefetched this batch (double-buffering).  ``batch``
-    materializes the host-side batch for callbacks, lazily so cached
-    epochs do not pay host collation unless something looks.
+    materializes the host-side batch for callbacks; the engine only
+    calls it when some callback overrides a per-batch hook
+    (``Trainer._any_batch_hook``), so cached epochs under default
+    callbacks never pay host collation at all.
     """
 
     batch_idx: int
@@ -391,13 +393,31 @@ class CachedSource:
         perm = perm.astype(np.int32)
         if self._last_perm is None or not np.array_equal(
                 perm, self._last_perm):
+            if self._flat is None:
+                # the flat upload was dropped (shuffle=False promised a
+                # stable index order) yet this epoch's perm CHANGED — a
+                # loader whose _indices() varies without advertising
+                # shuffle=True.  Re-upload from the dataset and carry on
+                # (correctness first; the re-upload cost only hits such
+                # pathological loaders, and only on the epochs that
+                # actually change order).
+                _log.warning(
+                    "cache_train_dataset: loader %s changed its epoch "
+                    "index order despite shuffle=False; re-uploading the "
+                    "flat device cache (set shuffle=True to keep it "
+                    "resident).", type(loader).__name__)
+                if not self.build():   # pragma: no cover — build
+                    raise RuntimeError(  # succeeded once already
+                        "cache_train_dataset: flat cache re-upload failed")
             self._repacked = self._repack_jit(self._flat, perm)
             self._last_perm = perm
             if not getattr(loader, "shuffle", False):
-                # membership is fixed for the rest of the fit (the
-                # epoch index order is deterministic without shuffle):
+                # membership claims to be fixed for the rest of the fit:
                 # drop the flat upload instead of pinning a second full
-                # dataset copy in device memory all fit long
+                # dataset copy in device memory all fit long (eagerly —
+                # keeping it through epoch 1 would regress peak HBM; the
+                # warning path above covers loaders that break the
+                # promise)
                 self._flat = None
         # host-batch memo for callback arguments: valid while membership
         # (perm) is unchanged, so no-shuffle epochs collate each batch

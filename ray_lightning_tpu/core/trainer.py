@@ -127,7 +127,8 @@ class Trainer:
         # host→device batch transfer entirely (the measured bottleneck
         # for small models on tunneled TPUs: ~28 MB/s link vs
         # microsecond compute).  See core/loop_engine.py CachedSource.
-        # Single-process only; combine with steps_per_execution>1.
+        # Works single- and multi-process (the flat cache becomes one
+        # global sharded array); combine with steps_per_execution>1.
         self.cache_train_dataset = bool(cache_train_dataset)
         self.gradient_clip_val = gradient_clip_val
         self.precision = str(precision)
@@ -654,6 +655,9 @@ class Trainer:
         dispatch.  Replaces the round-2 trio of divergent loops.
         """
         source = self._train_source(train_loader, strategy)
+        # computed once per epoch, not per step: the callback list is
+        # fixed within an epoch and this sits on the hot loop
+        self._engine_hooks = self._batch_hook_plan()
         k = self.steps_per_execution
         while not (self.should_stop or self._max_steps_reached()):
             allowed = self._allowed_chunk()
@@ -681,36 +685,72 @@ class Trainer:
             self._eval_loop(module, "validate", val_loader,
                             self.limit_val_batches)
 
-    def _engine_one(self, module, source, item) -> None:
-        batch = item.batch()
+    def _batch_hook_plan(self) -> tuple:
+        """(invoke, materialize): does any callback override a per-batch
+        hook, and does any overriding one actually read ``batch``
+        (``Callback.needs_batch``)?  When nothing overrides, the engine
+        skips the hook calls; when overriders all declare
+        ``needs_batch = False`` they are invoked with ``batch=None`` —
+        either way cached (especially shuffled) epochs never pay host
+        collation for arguments nobody reads (the whole point of the
+        cached path is removing per-step host work).  Detection goes
+        through ``__func__`` so instance-assigned hooks
+        (``cb.on_train_batch_end = fn``) count as overrides too.
+        """
+        from ray_lightning_tpu.core.callbacks import Callback as _Base
+
+        def overrides(cb, name):
+            fn = getattr(cb, name, None)
+            return getattr(fn, "__func__", fn) is not getattr(_Base, name)
+
+        invoke = materialize = False
         for cb in self.callbacks:
-            cb.on_train_batch_start(self, module, batch, item.batch_idx)
+            if overrides(cb, "on_train_batch_start") \
+                    or overrides(cb, "on_train_batch_end"):
+                invoke = True
+                if getattr(cb, "needs_batch", True):
+                    materialize = True
+        return invoke, materialize
+
+    def _engine_one(self, module, source, item) -> None:
+        invoke, want_batch = self._engine_hooks
+        if invoke:
+            batch = item.batch() if want_batch else None
+            for cb in self.callbacks:
+                cb.on_train_batch_start(self, module, batch, item.batch_idx)
         metrics = source.run_one(self, item)
         self.global_step += 1
         self._accumulate_metrics(metrics)
         if self.global_step % self.log_every_n_steps == 0:
             self._publish_metrics(metrics)
-        for cb in self.callbacks:
-            cb.on_train_batch_end(self, module, metrics, batch,
-                                  item.batch_idx)
+        if invoke:
+            for cb in self.callbacks:
+                cb.on_train_batch_end(self, module, metrics, batch,
+                                      item.batch_idx)
 
     def _engine_chunk(self, module, source, items) -> None:
         """k steps in ONE dispatch; batch-granular callbacks coarsen to
         once per chunk (starts for every batch, one end with the chunk's
         stacked metrics and its last batch)."""
-        for it in items:
-            for cb in self.callbacks:
-                cb.on_train_batch_start(self, module, it.batch(),
-                                        it.batch_idx)
+        invoke, want_batch = self._engine_hooks
+        if invoke:
+            for it in items:
+                for cb in self.callbacks:
+                    cb.on_train_batch_start(
+                        self, module, it.batch() if want_batch else None,
+                        it.batch_idx)
         before = self.global_step
         metrics = source.run_chunk(self, items)
         self.global_step += len(items)
         self._accumulate_metrics(metrics)
         self._publish_if_crossed(before, jax.tree_util.tree_map(
             lambda a: a[-1], metrics))
-        for cb in self.callbacks:
-            cb.on_train_batch_end(self, module, metrics, items[-1].batch(),
-                                  items[-1].batch_idx)
+        if invoke:
+            for cb in self.callbacks:
+                cb.on_train_batch_end(
+                    self, module, metrics,
+                    items[-1].batch() if want_batch else None,
+                    items[-1].batch_idx)
 
     # -- metrics ---------------------------------------------------------
 

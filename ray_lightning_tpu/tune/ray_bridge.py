@@ -128,15 +128,74 @@ def report(metrics: dict) -> bool:
         return True
     if _tune_context() is not None:
         from ray import tune
-        return _report_with_staged(lambda m, c: tune.report(m, checkpoint=c)
-                                   if c is not None else tune.report(m),
-                                   metrics)
+        if _report_accepts_checkpoint(tune.report):
+            return _report_with_staged(
+                lambda m, c: tune.report(m, checkpoint=c)
+                if c is not None else tune.report(m), metrics)
+        # MID-generation Ray: tune.get_context exists but tune.report
+        # still has the classic kwargs-only signature — calling it with
+        # a positional dict would TypeError.  Prefer the train session
+        # (which can attach staged checkpoints); else deliver metrics
+        # classic-style (any staged checkpoint stays pending and the
+        # stage path's replacement warning covers it).
+        if _train_session() is not None:
+            from ray import train
+            return _report_with_staged(
+                lambda m, c: train.report(m, checkpoint=c)
+                if c is not None else train.report(m), metrics)
+        _deliver_staged_classic(tune)
+        tune.report(**metrics)
+        return True
     if _train_session() is not None:
         from ray import train
         return _report_with_staged(lambda m, c: train.report(m, checkpoint=c)
                                    if c is not None else train.report(m),
                                    metrics)
     return False
+
+
+def _deliver_staged_classic(tune) -> None:
+    """Mid-generation last resort for a staged checkpoint: the report
+    about to go out is kwargs-only and cannot attach it.  If this Ray
+    still ships the classic ``tune.checkpoint_dir``, write the staged
+    files there (the reference's own move, tune.py:161-167); otherwise
+    warn LOUDLY and drop — silently losing a trial's checkpoints is the
+    one unacceptable outcome."""
+    staged = getattr(_local, "pending_checkpoint", None)
+    if staged is None:
+        return
+    _local.pending_checkpoint = None
+    step = getattr(_local, "pending_step", 0)
+    try:
+        ckpt_dir = getattr(tune, "checkpoint_dir", None)
+        if ckpt_dir is not None:
+            with ckpt_dir(step=step) as d:
+                for name in os.listdir(staged):
+                    shutil.copy2(os.path.join(staged, name),
+                                 os.path.join(d, name))
+            return
+        _log.warning(
+            "Staged Tune checkpoint dropped: this Ray generation's "
+            "tune.report cannot attach checkpoints and tune.checkpoint_dir "
+            "is gone; install a Ray with the modern report signature to "
+            "record checkpoints from this callback.")
+    finally:
+        shutil.rmtree(staged, ignore_errors=True)
+
+
+def _report_accepts_checkpoint(report_fn) -> bool:
+    """True when ``report_fn`` takes a ``checkpoint`` kwarg (the modern
+    positional-dict signature).  Mid-generation Ray ships
+    ``tune.get_context`` while ``tune.report`` keeps the classic
+    kwargs-only signature; probing the signature (instead of catching a
+    TypeError mid-call) keeps staged checkpoints from being consumed by
+    a call that was never going to deliver them."""
+    import inspect
+    try:
+        params = inspect.signature(report_fn).parameters
+    except (TypeError, ValueError):
+        return True   # uninspectable builtins: assume modern
+    return "checkpoint" in params
 
 
 def _report_with_staged(report_fn, metrics: dict) -> bool:
@@ -196,6 +255,7 @@ def stage_checkpoint(blob: bytes, step: int, filename: str) -> bool:
         with open(os.path.join(d, filename), "wb") as f:
             f.write(blob)
         _local.pending_checkpoint = d
+        _local.pending_step = step   # classic-dir fallback needs it
         return True
     return False
 

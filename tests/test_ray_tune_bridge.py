@@ -313,3 +313,111 @@ def test_classic_checkpoint_through_actor_queue(classic_session, seed,
     path = os.path.join(classic_session["ckpt_dirs"][-1], "checkpoint")
     ckpt = Trainer.load_checkpoint_dict(path)
     assert ckpt["global_step"] > 0 and "state" in ckpt
+
+
+@pytest.fixture
+def midgen_session(monkeypatch):
+    """Stub of a MID-generation Ray: ``tune.get_context`` exists (so the
+    context probe fires) but ``tune.report`` still has the classic
+    kwargs-only signature — calling it with a positional dict would
+    TypeError (ADVICE r3 #3).  No ``is_session_enabled``."""
+    state = {"kw_reports": [], "train_reports": []}
+    ray = types.ModuleType("ray")
+    tune_mod = types.ModuleType("ray.tune")
+
+    class _Ctx:
+        def get_trial_id(self):
+            return "trial_0001"
+
+    tune_mod.get_context = lambda: _Ctx()
+
+    def kw_report(**kwargs):
+        state["kw_reports"].append(kwargs)
+
+    tune_mod.report = kw_report
+    ray.tune = tune_mod
+    monkeypatch.setitem(sys.modules, "ray", ray)
+    monkeypatch.setitem(sys.modules, "ray.tune", tune_mod)
+    return state
+
+
+def test_midgen_report_falls_back_to_kwargs(midgen_session, seed):
+    """Context live + kwargs-only tune.report + no train session: the
+    bridge must deliver metrics classic-style instead of raising
+    TypeError mid-trial."""
+    _fit(tune.TuneReportCallback(on="validation_end"))
+    assert len(midgen_session["kw_reports"]) == 2
+    for r in midgen_session["kw_reports"]:
+        assert "val_loss" in r
+
+
+def test_midgen_prefers_train_session_for_checkpoints(midgen_session,
+                                                      monkeypatch, seed):
+    """Context live + kwargs-only tune.report + a train session present:
+    reports (and staged checkpoints) must route through train.report —
+    the only generation-appropriate surface that can attach them."""
+    internal = types.ModuleType("ray.train._internal")
+    session_mod = types.ModuleType("ray.train._internal.session")
+    session_mod.get_session = lambda: object()
+    train_mod = types.ModuleType("ray.train")
+
+    class Checkpoint:
+        def __init__(self, path):
+            self.path = path
+
+        @classmethod
+        def from_directory(cls, path):
+            return cls(path)
+
+    def train_report(metrics, checkpoint=None):
+        files = {}
+        if checkpoint is not None:
+            for name in os.listdir(checkpoint.path):
+                with open(os.path.join(checkpoint.path, name), "rb") as f:
+                    files[name] = f.read()
+        midgen_session["train_reports"].append(
+            {"metrics": metrics, "files": files})
+
+    train_mod.report = train_report
+    train_mod.Checkpoint = Checkpoint
+    sys.modules["ray"].train = train_mod
+    for name, mod in [("ray.train", train_mod),
+                      ("ray.train._internal", internal),
+                      ("ray.train._internal.session", session_mod)]:
+        monkeypatch.setitem(sys.modules, name, mod)
+    _fit(tune.TuneReportCheckpointCallback(on="validation_end"))
+    assert midgen_session["kw_reports"] == []
+    reports = midgen_session["train_reports"]
+    assert len(reports) == 2
+    for r in reports:
+        assert "val_loss" in r["metrics"]
+        blob = r["files"]["checkpoint"]
+        ckpt = serialization.msgpack_restore(blob)
+        assert ckpt["global_step"] > 0 and "state" in ckpt
+
+
+def test_midgen_staged_checkpoint_lands_in_classic_dir(midgen_session,
+                                                       monkeypatch, seed,
+                                                       tmp_path):
+    """Mid-generation with classic tune.checkpoint_dir still present:
+    a staged checkpoint must be written there (not silently dropped)
+    when the kwargs-only report goes out."""
+    tune_mod = sys.modules["ray.tune"]
+    dirs = []
+
+    @contextlib.contextmanager
+    def checkpoint_dir(step):
+        d = tmp_path / f"ckpt_{step}_{len(dirs)}"
+        d.mkdir()
+        dirs.append(str(d))
+        yield str(d)
+
+    tune_mod.checkpoint_dir = checkpoint_dir
+    _fit(tune.TuneReportCheckpointCallback(on="validation_end"))
+    assert len(midgen_session["kw_reports"]) == 2
+    assert len(dirs) == 2
+    for d in dirs:
+        path = os.path.join(d, "checkpoint")
+        assert os.path.isfile(path)
+    ckpt = Trainer.load_checkpoint_dict(path)
+    assert ckpt["global_step"] > 0 and "state" in ckpt
