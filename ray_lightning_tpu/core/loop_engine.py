@@ -196,6 +196,7 @@ class CachedSource:
         self._pos = 0
         self._host_memo: Optional[dict] = None
         self._host_memo_perm: Optional[np.ndarray] = None
+        self._promise_broken = False   # loader changed order w/o shuffle
         self.exhausted = False
 
     # -- construction ---------------------------------------------------
@@ -397,21 +398,24 @@ class CachedSource:
                 # the flat upload was dropped (shuffle=False promised a
                 # stable index order) yet this epoch's perm CHANGED — a
                 # loader whose _indices() varies without advertising
-                # shuffle=True.  Re-upload from the dataset and carry on
-                # (correctness first; the re-upload cost only hits such
-                # pathological loaders, and only on the epochs that
-                # actually change order).
+                # shuffle=True.  Re-upload from the dataset once, then
+                # treat the loader as shuffling (keep the flat copy
+                # resident) so the O(dataset) re-upload doesn't repeat
+                # every order-changing epoch.
                 _log.warning(
                     "cache_train_dataset: loader %s changed its epoch "
                     "index order despite shuffle=False; re-uploading the "
-                    "flat device cache (set shuffle=True to keep it "
-                    "resident).", type(loader).__name__)
+                    "flat device cache once and keeping it resident (set "
+                    "shuffle=True to declare this upfront).",
+                    type(loader).__name__)
+                self._promise_broken = True
                 if not self.build():   # pragma: no cover — build
                     raise RuntimeError(  # succeeded once already
                         "cache_train_dataset: flat cache re-upload failed")
             self._repacked = self._repack_jit(self._flat, perm)
             self._last_perm = perm
-            if not getattr(loader, "shuffle", False):
+            if not getattr(loader, "shuffle", False) \
+                    and not self._promise_broken:
                 # membership claims to be fixed for the rest of the fit:
                 # drop the flat upload instead of pinning a second full
                 # dataset copy in device memory all fit long (eagerly —

@@ -655,9 +655,6 @@ class Trainer:
         dispatch.  Replaces the round-2 trio of divergent loops.
         """
         source = self._train_source(train_loader, strategy)
-        # computed once per epoch, not per step: the callback list is
-        # fixed within an epoch and this sits on the hot loop
-        self._engine_hooks = self._batch_hook_plan()
         k = self.steps_per_execution
         while not (self.should_stop or self._max_steps_reached()):
             allowed = self._allowed_chunk()
@@ -696,12 +693,13 @@ class Trainer:
         cached path is removing per-step host work).  Detection goes
         through ``__func__`` so instance-assigned hooks
         (``cb.on_train_batch_end = fn``) count as overrides too.
+        Recomputed per engine call (a few attribute reads on a short
+        list) so callbacks added or hook-assigned MID-epoch are honored
+        exactly as they were before the skip existed.
         """
-        from ray_lightning_tpu.core.callbacks import Callback as _Base
-
         def overrides(cb, name):
             fn = getattr(cb, name, None)
-            return getattr(fn, "__func__", fn) is not getattr(_Base, name)
+            return getattr(fn, "__func__", fn) is not getattr(Callback, name)
 
         invoke = materialize = False
         for cb in self.callbacks:
@@ -713,7 +711,7 @@ class Trainer:
         return invoke, materialize
 
     def _engine_one(self, module, source, item) -> None:
-        invoke, want_batch = self._engine_hooks
+        invoke, want_batch = self._batch_hook_plan()
         if invoke:
             batch = item.batch() if want_batch else None
             for cb in self.callbacks:
@@ -732,7 +730,7 @@ class Trainer:
         """k steps in ONE dispatch; batch-granular callbacks coarsen to
         once per chunk (starts for every batch, one end with the chunk's
         stacked metrics and its last batch)."""
-        invoke, want_batch = self._engine_hooks
+        invoke, want_batch = self._batch_hook_plan()
         if invoke:
             for it in items:
                 for cb in self.callbacks:

@@ -6,7 +6,12 @@ TPU-first rationale: with fp32-resident params and bf16 compute (flax
 every backward produces an fp32 cotangent — on the gpt2-small headline
 that is ~8.7 ms/step of pure dtype-convert fusions (benchmarks/README.md
 device trace).  Keeping the *resident* params bf16 deletes those casts
-from the hot program (and halves DDP gradient-allreduce bytes); full
+from the hot program and halves param HBM residency.  (It does NOT
+shrink the gradient all-reduce: the partitioner must resolve each
+cross-replica partial sum at the f32-accumulating grad dot, BEFORE the
+bf16 cotangent cast — summing bf16-rounded partials would change the
+numerics — so gradient collectives ride at f32 by construction; audited
+at the compiled-HLO level in tests/test_collective_audit.py.)  Full
 precision is preserved where it matters — the optimizer update — by an
 fp32 master copy inside the optimizer state.  This is the classic
 mixed-precision recipe; on ZeRO-1/SPMD meshes the master shards with

@@ -185,8 +185,16 @@ class Zero1Strategy(ShardingStrategy):
 
     Parity target for ``RayShardedPlugin`` (ray_ddp_sharded.py:17-34):
     FairScale OSS shards optimizer state across DDP ranks; here the same
-    partitioning is a sharding annotation on the opt-state pytree and XLA
-    emits reduce-scatter(grads) → sharded update → all-gather(params).
+    partitioning is a sharding annotation on the opt-state pytree.  What
+    the annotation guarantees (audited at the compiled-HLO level in
+    tests/test_collective_audit.py): the optimizer update math and its
+    f32 master/moment buffers are 1/N-sized per device, each rank
+    slices its shard of the summed grads, and the updated params are
+    re-assembled with an all-gather.  Whether the grad-sum + slice pair
+    lowers to a literal reduce-scatter is an XLA backend pass
+    (ReduceScatterCreator) — the audited CPU lowering emits
+    all-reduce + dynamic-slice; byte-for-byte the memory story is the
+    OSS one either way.
 
     ``min_shard_elements`` leaves tiny leaves replicated (collective
     latency beats memory savings below a threshold).

@@ -135,17 +135,14 @@ def report(metrics: dict) -> bool:
         # MID-generation Ray: tune.get_context exists but tune.report
         # still has the classic kwargs-only signature — calling it with
         # a positional dict would TypeError.  Prefer the train session
-        # (which can attach staged checkpoints); else deliver metrics
-        # classic-style (any staged checkpoint stays pending and the
-        # stage path's replacement warning covers it).
-        if _train_session() is not None:
-            from ray import train
-            return _report_with_staged(
-                lambda m, c: train.report(m, checkpoint=c)
-                if c is not None else train.report(m), metrics)
-        _deliver_staged_classic(tune)
-        tune.report(**metrics)
-        return True
+        # (falls through to the branch below, which can attach staged
+        # checkpoints); with no train session, deliver a staged
+        # checkpoint via the classic dir if it survives, then the
+        # metrics classic-style.
+        if _train_session() is None:
+            _deliver_staged_classic(tune)
+            tune.report(**metrics)
+            return True
     if _train_session() is not None:
         from ray import train
         return _report_with_staged(lambda m, c: train.report(m, checkpoint=c)

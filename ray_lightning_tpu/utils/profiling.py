@@ -144,6 +144,8 @@ class JaxProfilerCallback(Callback):
     num_steps) of training; written under ``log_dir`` (default
     ``<default_root_dir>/profile``) for TensorBoard/Perfetto."""
 
+    needs_batch = False   # windows on global_step; never reads the batch
+
     def __init__(self, start_step: int = 5, num_steps: int = 5,
                  log_dir: Optional[str] = None):
         self.start_step = int(start_step)

@@ -1,0 +1,171 @@
+"""Compiled-collective audit: the sharding claims that matter on a pod,
+asserted over the ACTUAL lowered programs on the 8-virtual-device mesh
+(VERDICT r3 next #3).
+
+Round 3 asserted these in docstrings; this file asserts them against
+``jit(...).lower(...).compile()`` — op kinds, element types, and
+per-device argument bytes — so a strategy that silently degrades to the
+wrong collective, loses its sharding, or widens a buffer to fp32 fails
+CI instead of shipping a pod-scale regression no single-chip bench can
+see.
+
+Audited facts (current XLA CPU lowering; shapes/bytes are
+backend-independent sharding truth, op *formation* can vary by backend
+pass pipeline — reduce-scatter creation is such a pass, which is why
+the ZeRO-1 assertion accepts all-reduce + dynamic-slice as the summed
+grads' spelling):
+
+- DDP: grads cross-replica summed (all-reduce), params NEVER gathered
+  (they are replicated), full-size optimizer buffers.
+- ZeRO-1: optimizer buffers 1/N per device, each rank slices its grad
+  shard, updated params re-assembled by all-gather.
+- FSDP: params also 1/N; all-gathers at use sites (strictly more than
+  ZeRO-1's single post-update gather).
+- Gradient collectives ride at f32 — the partitioner resolves partial
+  sums at the f32-accumulating grad dots, before the bf16 cotangent
+  cast (a bf16 all-reduce here would be a silent numerics change; a
+  f64 one a silent widening — both fail this audit).
+
+Reference anchor: SURVEY.md §2.2 FairScale row (reduce-scatter /
+all-gather is the stated parity mechanism, ray_ddp_sharded.py:17-34).
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.core.steps import build_init_fn, build_train_step
+from ray_lightning_tpu.models.gpt import GPTLightningModule
+from ray_lightning_tpu.parallel.strategy import resolve_strategy
+
+BATCH = 16
+
+
+def _compiled(strategy, **module_kw):
+    strat = resolve_strategy(strategy) if isinstance(strategy, str) \
+        else strategy
+    module = GPTLightningModule("tiny", dataset_size=4 * BATCH,
+                                batch_size=BATCH, **module_kw)
+    module.setup_model()
+    tx = module.configure_optimizers()
+    mesh = strat.build_mesh(batch_hint=BATCH)
+    batch = jax.tree_util.tree_map(
+        np.asarray, next(iter(module.train_dataloader())))
+    abstract = jax.eval_shape(build_init_fn(module, tx),
+                              jax.random.PRNGKey(0), batch)
+    shardings = strat.state_shardings(mesh, abstract)
+    jitted = jax.jit(build_train_step(module, tx), donate_argnums=0,
+                     in_shardings=(shardings,
+                                   strat.batch_shardings(mesh, batch)),
+                     out_shardings=(shardings, None))
+    return mesh, jitted.lower(abstract, batch).compile()
+
+
+@pytest.fixture(scope="module")
+def programs():
+    """One compile per strategy, shared by every assertion below."""
+    out = {}
+    for name in ("ddp", "zero1", "fsdp"):
+        mesh, comp = _compiled(name)
+        assert dict(mesh.shape)["data"] == 8, "audit needs the full mesh"
+        out[name] = {
+            "text": comp.as_text(),
+            "args": comp.memory_analysis().argument_size_in_bytes,
+        }
+    return out
+
+
+def _count(text: str, op: str) -> int:
+    """Occurrences of collective-op DEFINITIONS (async start variants
+    count once; `-done` and get-tuple-element references do not)."""
+    return len(re.findall(rf"= \(?[a-z0-9]+\[[^)]*?\]\S* {op}(?:-start)?\(",
+                          text))
+
+
+def _def_dtypes(text: str, op: str) -> set:
+    """Element types produced by ``op`` definitions (tuple or scalar)."""
+    out = set()
+    for m in re.finditer(rf"= (\(?)([a-z0-9]+)\[[^)]*?\]\S* {op}", text):
+        if m.group(1):   # tuple type: collect every element type inside
+            span = text[m.start():text.index(")", m.start())]
+            out.update(re.findall(r"([a-z0-9]+)\[", span))
+        else:
+            out.add(m.group(2))
+    return out
+
+
+def test_ddp_allreduces_grads_and_never_gathers_params(programs):
+    t = programs["ddp"]["text"]
+    assert _count(t, "all-reduce") > 0, "DDP lost its gradient psum"
+    assert _count(t, "all-gather") == 0, (
+        "DDP program gathers something — params/opt must be replicated")
+    assert _count(t, "reduce-scatter") == 0
+
+
+def test_zero1_shards_update_and_gathers_params(programs):
+    t = programs["zero1"]["text"]
+    # summed grads: either a literal reduce-scatter or the partitioner's
+    # all-reduce + per-rank dynamic-slice spelling
+    rs = _count(t, "reduce-scatter")
+    assert rs > 0 or (_count(t, "all-reduce") > 0
+                      and t.count("dynamic-slice") > 0), (
+        "ZeRO-1 lost the sharded-update pattern entirely")
+    assert _count(t, "all-gather") > 0, (
+        "ZeRO-1 must re-assemble updated params with an all-gather")
+
+
+def test_fsdp_gathers_params_at_use_sites(programs):
+    ag_fsdp = _count(programs["fsdp"]["text"], "all-gather")
+    ag_zero1 = _count(programs["zero1"]["text"], "all-gather")
+    assert ag_fsdp > ag_zero1 > 0, (
+        f"FSDP should gather params at use sites (fwd+bwd): "
+        f"{ag_fsdp} vs zero1's {ag_zero1}")
+
+
+def test_grad_allreduce_rides_f32(programs):
+    """The cross-replica grad sum must stay f32: bf16 would silently
+    change numerics (summing rounded partials), f64 silently widen the
+    dominant collective (module docstring, ops/optim.py)."""
+    for name in ("ddp", "zero1", "fsdp"):
+        types = _def_dtypes(programs[name]["text"], "all-reduce")
+        assert types and types <= {"f32"}, (
+            f"{name}: gradient all-reduce element types {types} != f32")
+
+
+def test_per_device_state_bytes_order(programs):
+    """The memory story IS the point of the sharded strategies: per
+    device, fsdp (params+opt sharded) < zero1 (opt sharded) < ddp
+    (everything replicated).  A lost sharding annotation collapses one
+    of these gaps."""
+    ddp = programs["ddp"]["args"]
+    zero1 = programs["zero1"]["args"]
+    fsdp = programs["fsdp"]["args"]
+    assert fsdp < zero1 < ddp, (ddp, zero1, fsdp)
+    # opt state (f32 master + bf16 mu + f32 nu ≈ 5 bytes/param) dwarfs
+    # bf16 params; sharding it 8-way should reclaim well over half
+    assert zero1 < 0.45 * ddp, (zero1, ddp)
+    # fsdp shards the bf16 params too
+    assert fsdp < 0.75 * zero1, (fsdp, zero1)
+
+
+def test_tensor_parallel_psums_forward(programs):
+    """Megatron-style tensor parallelism: row-parallel matmuls produce
+    partial activations that MUST be psum'd in the forward pass — a
+    tensor-sharded program with no all-reduce is silently computing
+    garbage.  Params shard on the tensor axis, so per-device state
+    bytes drop vs DDP."""
+    from ray_lightning_tpu.models.gpt import gpt_partition_rules
+    from ray_lightning_tpu.parallel.strategy import SpmdStrategy
+
+    strat = SpmdStrategy(rules=gpt_partition_rules(),
+                         axis_names=("data", "tensor"),
+                         axis_sizes={"tensor": 2})
+    mesh, comp = _compiled(strat)
+    assert dict(mesh.shape) == {"data": 4, "tensor": 2}
+    assert _count(comp.as_text(), "all-reduce") > 0
+    assert comp.memory_analysis().argument_size_in_bytes \
+        < 0.8 * programs["ddp"]["args"]
