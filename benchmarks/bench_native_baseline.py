@@ -78,7 +78,11 @@ def _time_native(step, state, batches, fetch, warmup, timed) -> float:
     return rate
 
 
-def _drive(step, state, batches, fetch, steps=8):
+def _drive(step, state, batches, fetch, steps=None):
+    if steps is None:
+        # big-model traces can exhaust the profiler's device buffer;
+        # RLT_TRACE_STEPS shrinks the captured window
+        steps = int(os.environ.get("RLT_TRACE_STEPS", "8"))
     for i in range(steps):
         state = step(state, batches[i % len(batches)])
     fetch(state)
@@ -299,29 +303,41 @@ def framework_resnet50(platform):
     _emit_framework_device(res)
 
 
-# -- workload: GPT-2 (BASELINE #5 headline) ---------------------------------
+# -- workloads: GPT-2 small (BASELINE #5 headline) and medium (the remat
+# regime, gateway to config #5's 1.3B) — one shared leg body -----------------
 
 GPT_STEPS = (3, 30)
+GPT_MEDIUM_STEPS = (3, 20)
 
 
-def _gpt_parts(platform):
+def _gpt_module(platform, cfg_name, steps):
     from ray_lightning_tpu.models.gpt import GPTLightningModule
 
-    cfg_name = "gpt2-small" if platform != "cpu" else "tiny"
-    warmup, timed = GPT_STEPS
-    module = GPTLightningModule(
-        cfg_name, dataset_size=8 * (warmup + timed + 2), batch_size=8)
-    return cfg_name, module
+    resolved = cfg_name if platform != "cpu" else "tiny"
+    warmup, timed = steps
+    return resolved, GPTLightningModule(
+        resolved, dataset_size=8 * (warmup + timed + 2), batch_size=8)
 
 
-def native_gpt2(platform):
+def _native_gpt_leg(platform, cfg_name, steps, remat_policy=None):
+    """Raw-JAX loop over the named GPT config (optax full-logits CE —
+    what a competent user writes).  ``remat_policy`` pins the native
+    leg's policy independently of the config default: at gpt2-medium
+    the framework's best policy ("dots") OOMs under this loop's fp32
+    logits, so its native leg runs "full" — its only runnable policy —
+    and the README records the asymmetry."""
+    import dataclasses
+
     from ray_lightning_tpu.models.gpt import GPT
 
-    warmup, timed = GPT_STEPS
-    cfg_name, module = _gpt_parts(platform)
+    warmup, timed = steps
+    resolved, module = _gpt_module(platform, cfg_name, steps)
     batches = _collect_batches(module.train_dataloader(), warmup + timed)
 
-    model = GPT(module.config)
+    config = module.config
+    if remat_policy is not None and config.remat:
+        config = dataclasses.replace(config, remat_policy=remat_policy)
+    model = GPT(config)
     tx = module.configure_optimizers()
     params = model.init(jax.random.PRNGKey(0), batches[0][0])["params"]
     params, opt = _init_like_framework(module, params, tx)
@@ -345,15 +361,40 @@ def native_gpt2(platform):
     _emit(f"{cfg_name}_native_steps_per_sec_{platform}", native)
 
 
-def framework_gpt2(platform):
+def _framework_gpt_leg(platform, cfg_name, steps, mfu: bool = False):
     from benchmarks.harness import run_steps_per_sec
 
-    warmup, timed = GPT_STEPS
-    cfg_name, module = _gpt_parts(platform)
+    warmup, timed = steps
+    _, module = _gpt_module(platform, cfg_name, steps)
     res = run_steps_per_sec(
         module, f"{cfg_name}_framework_steps_per_sec_{platform}",
         warmup=warmup, timed=timed, trace_steps=8)
-    _emit_framework_device(res)
+    med = _emit_framework_device(res)
+    if med and mfu:
+        # analytic MFU counts the MODEL's 3x fwd+bwd FLOPs only; remat
+        # recompute is real extra device work on top, so this reads LOW
+        # in the remat regime by construction
+        _emit_mfu(module, med, f"{cfg_name}_model_mfu_{platform}")
+
+
+def native_gpt2(platform):
+    _native_gpt_leg(platform, "gpt2-small" if platform != "cpu"
+                    else "tiny", GPT_STEPS)
+
+
+def framework_gpt2(platform):
+    _framework_gpt_leg(platform, "gpt2-small" if platform != "cpu"
+                       else "tiny", GPT_STEPS)
+
+
+def native_gpt2_medium(platform):
+    _native_gpt_leg(platform, "gpt2-medium", GPT_MEDIUM_STEPS,
+                    remat_policy="full")
+
+
+def framework_gpt2_medium(platform):
+    _framework_gpt_leg(platform, "gpt2-medium", GPT_MEDIUM_STEPS,
+                       mfu=True)
 
 
 # -- workload: BERT-base masked-LM, ZeRO-1 (BASELINE #4) ---------------------
@@ -497,6 +538,7 @@ WORKLOADS = {
     "mnist": (native_mnist, framework_mnist),
     "resnet50": (native_resnet50, framework_resnet50),
     "gpt2": (native_gpt2, framework_gpt2),
+    "gpt2_medium": (native_gpt2_medium, framework_gpt2_medium),
     "bert_zero1": (native_bert_zero1, framework_bert_zero1),
     "moe": (native_moe, framework_moe),
 }

@@ -1,13 +1,13 @@
-"""Trace the gpt2-small headline train step and print a device-time
-breakdown.
+"""Trace a GPT-config train step and print a device-time breakdown.
 
-Usage:  python -m benchmarks.profile_headline [steps]
+Usage:  python -m benchmarks.profile_headline [steps] [config]
 
-Builds the same compiled train step the Trainer runs (core/steps.py),
-warms it OUTSIDE the trace (the tunnel profiler drops op events when
-compilation floods the capture window), then traces ``steps`` warm
-executions.  Env toggles under test (RLT_BF16_PARAMS /
-RLT_BF16_MOMENTS / RLT_FLASH_*) are read by the model as usual, so A/B
+``config`` is any ``models.gpt.CONFIGS`` name (default gpt2-small, the
+headline).  Builds the same compiled train step the Trainer runs
+(core/steps.py), warms it OUTSIDE the trace (the tunnel profiler drops
+op events when compilation floods the capture window), then traces
+``steps`` warm executions.  Env toggles under test (RLT_BF16_PARAMS /
+RLT_REMAT_POLICY / RLT_FLASH_*) are read by the model as usual, so A/B
 runs are just env changes.
 """
 
@@ -29,7 +29,8 @@ def main() -> None:
 
     timed = int(sys.argv[1]) if len(sys.argv) > 1 else 5
     platform = jax.devices()[0].platform
-    cfg = CONFIGS["gpt2-small" if platform != "cpu" else "tiny"]
+    default_cfg = "gpt2-small" if platform != "cpu" else "tiny"
+    cfg = CONFIGS[sys.argv[2] if len(sys.argv) > 2 else default_cfg]
     batch_size = 8
 
     module = GPTLightningModule(cfg, dataset_size=batch_size * 2,

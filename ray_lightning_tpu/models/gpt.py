@@ -26,6 +26,7 @@ import os
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -52,6 +53,15 @@ class GPTConfig:
     n_embd: int = 256
     dropout: float = 0.0
     remat: bool = True
+    # Which intermediates the block remat SAVES instead of recomputing
+    # (jax.checkpoint_policies): "full" = nothing saveable (max memory
+    # savings, max recompute); "dots" = keep matmul outputs (recompute
+    # only the cheap elementwise chains); "dots_no_batch" = keep only
+    # batch-free matmul outputs (≈ params-shaped, tiny).  The policy is
+    # THE lever of the memory-bound regime — measured walk in
+    # benchmarks/README.md (gpt2-medium).  ``RLT_REMAT_POLICY``
+    # overrides at model build for A/B sweeps.
+    remat_policy: str = "full"
     dtype: Any = jnp.bfloat16        # compute dtype; params stay fp32
     # "auto" | "dot" | "flash" | "ring" | "local" (ops/attention.py;
     # "local" = per-device flash/dot for manual shard_map regions)
@@ -84,8 +94,12 @@ CONFIGS = {
     # keep remat for memory headroom.
     "gpt2-small": GPTConfig(block_size=1024, n_layer=12, n_head=12,
                             n_embd=768, remat=False),
+    # dots_saveable: keep matmul outputs, recompute only elementwise
+    # chains — measured +17% steps/s over full remat on v5e (150.3 vs
+    # 177.4 ms/step device) and still fits with 6+ GB to spare; policy
+    # "off" needs 18.95 GB and OOMs (benchmarks/README.md round-4 walk)
     "gpt2-medium": GPTConfig(block_size=1024, n_layer=24, n_head=16,
-                             n_embd=1024),
+                             n_embd=1024, remat_policy="dots"),
     # 1.3B class: remat + chunked CE — at T=2048 the full fp32 logits
     # alone would be ~1.6GB/example-batch; the chunked loss streams them
     "gpt2-1p3b": GPTConfig(block_size=2048, n_layer=24, n_head=32,
@@ -138,6 +152,25 @@ class Block(nn.Module):
         return x
 
 
+def _remat_policy(name: str):
+    """jax.checkpoint policy for a config/env name (None = save nothing,
+    jax's default — the max-recompute end of the walk)."""
+    name = os.environ.get("RLT_REMAT_POLICY") or name
+    policies = {
+        "full": None,
+        "dots": jax.checkpoint_policies.dots_saveable,
+        "dots_no_batch":
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        # saves every intermediate == remat disabled in effect; the
+        # no-recompute endpoint of the policy walk
+        "off": jax.checkpoint_policies.everything_saveable,
+    }
+    if name not in policies:
+        raise ValueError(
+            f"remat_policy {name!r}; options: {sorted(policies)}")
+    return policies[name]
+
+
 class GPT(nn.Module):
     """Decoder-only transformer; ``__call__(tokens) -> logits``.
 
@@ -158,8 +191,10 @@ class GPT(nn.Module):
                               (cfg.block_size, cfg.n_embd))
         block = Block
         if cfg.remat:
-            # trade FLOPs for HBM: recompute block activations on backward
-            block = nn.remat(Block, static_argnums=(2,))
+            # trade FLOPs for HBM: recompute block activations on
+            # backward, keeping whatever the policy marks saveable
+            block = nn.remat(Block, static_argnums=(2,),
+                             policy=_remat_policy(cfg.remat_policy))
         self.blocks = [
             block(cfg, use_moe=(cfg.n_experts > 0
                                 and i % cfg.moe_every == cfg.moe_every - 1),
