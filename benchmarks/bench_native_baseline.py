@@ -337,6 +337,10 @@ def _native_gpt_leg(platform, cfg_name, steps, remat_policy=None):
     config = module.config
     if remat_policy is not None and config.remat:
         config = dataclasses.replace(config, remat_policy=remat_policy)
+        # the sweep env knob (models/gpt._remat_policy) outranks the
+        # config; pin it too, or a sweep run would drag the native leg
+        # onto a policy it cannot execute (fp32-logits OOM at "dots")
+        os.environ["RLT_REMAT_POLICY"] = remat_policy
     model = GPT(config)
     tx = module.configure_optimizers()
     params = model.init(jax.random.PRNGKey(0), batches[0][0])["params"]

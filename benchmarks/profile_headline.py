@@ -30,7 +30,11 @@ def main() -> None:
     timed = int(sys.argv[1]) if len(sys.argv) > 1 else 5
     platform = jax.devices()[0].platform
     default_cfg = "gpt2-small" if platform != "cpu" else "tiny"
-    cfg = CONFIGS[sys.argv[2] if len(sys.argv) > 2 else default_cfg]
+    cfg_name = sys.argv[2] if len(sys.argv) > 2 else default_cfg
+    if cfg_name not in CONFIGS:
+        raise SystemExit(
+            f"unknown config {cfg_name!r}; options: {sorted(CONFIGS)}")
+    cfg = CONFIGS[cfg_name]
     batch_size = 8
 
     module = GPTLightningModule(cfg, dataset_size=batch_size * 2,

@@ -108,8 +108,14 @@ CONFIGS = {
     # weights sharded on the `expert` mesh axis (ops/moe.py)
     "moe-tiny": GPTConfig(vocab_size=512, block_size=64, n_layer=2,
                           n_head=2, n_embd=64, remat=False, n_experts=4),
+    # dots remat beats BOTH full remat (92.7 ms) and no remat (95.3 ms)
+    # here: the dispatch/combine and expert-FFN intermediates are huge,
+    # and recomputing their elementwise chains is cheaper than
+    # round-tripping them through HBM (benchmarks/README.md round-4 MoE
+    # table; 80.1 ms/step, MFU 0.44 → 0.535)
     "gpt2-moe-8e": GPTConfig(block_size=1024, n_layer=12, n_head=12,
-                             n_embd=768, n_experts=8),
+                             n_embd=768, n_experts=8,
+                             remat_policy="dots"),
 }
 
 
