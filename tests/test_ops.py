@@ -272,3 +272,41 @@ def test_staircase_non_causal_unaffected(monkeypatch):
     out = flash_attention(q, k, v, causal=False, dtype=jnp.float32)
     ref = dot_product_attention(q, k, v, causal=False, dtype=jnp.float32)
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("sm_scale", [None, 0.1])  # fold and no-fold
+@pytest.mark.parametrize("rowres", ["1", "0"])
+def test_rowres_backward_matches_reference(rowres, sm_scale, monkeypatch):
+    """The row-resident fused triangular backward (default at
+    multi-block causal T<=2048) and the grid-tri pair it replaces must
+    BOTH match the reference — the env A/B pins the dispatch seam and
+    keeps the fallback path covered.  sm_scale=0.1 (not a power of
+    two) exercises the no-fold scaling branches, checked against the
+    full-precision einsum recipe directly (the XLA helper hardwires
+    1/sqrt(d))."""
+    from ray_lightning_tpu.ops.flash_attention import (_head_pack,
+                                                       _use_row_resident)
+    monkeypatch.setenv("RLT_FLASH_ROWRES", rowres)
+    assert _use_row_resident(256) == (rowres == "1")
+    assert _head_pack(64, 2) > 0
+    q, k, v = _rand_qkv(t=256, h=2, d=64)
+    scale = sm_scale if sm_scale is not None else 64 ** -0.5
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, dtype=jnp.float32,
+                            sm_scale=sm_scale, block_q=64, block_k=64)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        mask = np.tril(np.ones((256, 256), bool))
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        return jnp.sum(jnp.sin(o))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name} rowres={rowres}")
