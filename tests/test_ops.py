@@ -310,3 +310,31 @@ def test_rowres_backward_matches_reference(rowres, sm_scale, monkeypatch):
     for a, b, name in zip(g_flash, g_ref, "qkv"):
         np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5,
                                    err_msg=f"d{name} rowres={rowres}")
+
+
+def test_fwd_rowres_with_grid_tri_backward(monkeypatch):
+    """The 2048 < T <= 8192 production combination: row-resident FORWARD
+    (whose lse ships in the packed [B, H/pack, T, pack] layout) feeding
+    the grid-tri backward.  Forced at small T by disabling only the
+    backward gate — a layout drift between the two would break grads
+    here."""
+    import sys
+    fa = sys.modules["ray_lightning_tpu.ops.flash_attention"]
+    monkeypatch.setattr(fa, "_use_row_resident", lambda t: False)
+    assert fa._use_row_resident_fwd(256)
+    q, k, v = _rand_qkv(t=256, h=2, d=64)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, dtype=jnp.float32,
+                            block_q=64, block_k=64)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        o = dot_product_attention(q, k, v, causal=True, dtype=jnp.float32)
+        return jnp.sum(jnp.sin(o))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name} fwd-rowres+tri-bwd")
