@@ -633,12 +633,14 @@ def _bwd_tri_packed(q, k, v, h, lse, do, delta, sm_scale, bq, nq,
 # causal columns (no dead iterations, no per-block prefetch), dq
 # finalizes per row step, and dk/dv accumulate in fp32 VMEM scratch
 # via dynamic-slice read-modify-write, emitted once at the last row.
-# Engaged for T<=2048 (measured −15% whole fwd+bwd at 2048 vs the
-# grid-tri pair): at T=4096 the 512-tiles overflow the 16 MB scoped
-# VMEM by ~0.5 MB and the 256-tile variant measures 24.3 vs 19.5
-# ms/iter — [256,256]·c64 slabs underfeed the MXU — so longer
-# sequences keep the grid-tri kernels.  ``RLT_FLASH_ROWRES=0`` opts
-# out.
+# Engagement differs by direction (``RLT_FLASH_ROWRES=0`` opts out of
+# both): the FORWARD (online softmax in registers, no big scratch)
+# wins up to T=8192 (−15%/−16% at 4096/8192); the BACKWARD, whose
+# fp32 [T,128] dk/dv accumulators weigh on the scoped-VMEM budget,
+# caps at T=2048 (−28% whole fwd+bwd there with both kernels) — at
+# 4096 its 512-tiles overflow scoped VMEM by ~0.5 MB and 256-tiles
+# underfeed the MXU (24.3 vs 19.5 ms/iter), so longer sequences pair
+# the rowres forward with the grid-tri backward.
 
 
 def _use_row_resident(t: int) -> bool:
