@@ -335,34 +335,43 @@ def _native_gpt_leg(platform, cfg_name, steps, remat_policy=None):
     batches = _collect_batches(module.train_dataloader(), warmup + timed)
 
     config = module.config
-    if remat_policy is not None and config.remat:
-        config = dataclasses.replace(config, remat_policy=remat_policy)
-        # the sweep env knob (models/gpt._remat_policy) outranks the
-        # config; pin it too, or a sweep run would drag the native leg
-        # onto a policy it cannot execute (fp32-logits OOM at "dots")
-        os.environ["RLT_REMAT_POLICY"] = remat_policy
-    model = GPT(config)
-    tx = module.configure_optimizers()
-    params = model.init(jax.random.PRNGKey(0), batches[0][0])["params"]
-    params, opt = _init_like_framework(module, params, tx)
+    saved_policy = os.environ.get("RLT_REMAT_POLICY")
+    try:
+        if remat_policy is not None and config.remat:
+            config = dataclasses.replace(config, remat_policy=remat_policy)
+            # the sweep env knob (models/gpt._remat_policy) outranks the
+            # config; pin it too, or a sweep run would drag the native leg
+            # onto a policy it cannot execute (fp32-logits OOM at "dots")
+            os.environ["RLT_REMAT_POLICY"] = remat_policy
+        model = GPT(config)
+        tx = module.configure_optimizers()
+        params = model.init(jax.random.PRNGKey(0), batches[0][0])["params"]
+        params, opt = _init_like_framework(module, params, tx)
 
-    @jax.jit
-    def step(state, batch):
-        params, opt, _ = state
-        x, y = batch
+        @jax.jit
+        def step(state, batch):
+            params, opt, _ = state
+            x, y = batch
 
-        def loss_fn(p):
-            logits = model.apply({"params": p}, x, False)
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits, y).mean()
+            def loss_fn(p):
+                logits = model.apply({"params": p}, x, False)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y).mean()
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt = tx.update(grads, opt, params)
-        return optax.apply_updates(params, updates), opt, loss
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt = tx.update(grads, opt, params)
+            return optax.apply_updates(params, updates), opt, loss
 
-    native = _time_native(step, (params, opt, 0.0), batches,
-                          lambda s: float(np.asarray(s[2])), warmup, timed)
-    _emit(f"{cfg_name}_native_steps_per_sec_{platform}", native)
+        native = _time_native(step, (params, opt, 0.0), batches,
+                              lambda s: float(np.asarray(s[2])), warmup, timed)
+        _emit(f"{cfg_name}_native_steps_per_sec_{platform}", native)
+    finally:
+        # the policy pin must not outlive the leg when legs share a
+        # process (the subprocess-per-leg runner masks the leak)
+        if saved_policy is None:
+            os.environ.pop("RLT_REMAT_POLICY", None)
+        else:
+            os.environ["RLT_REMAT_POLICY"] = saved_policy
 
 
 def _framework_gpt_leg(platform, cfg_name, steps, mfu: bool = False):

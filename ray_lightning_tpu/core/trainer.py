@@ -687,7 +687,8 @@ class Trainer:
         hook, and does any overriding one actually read ``batch``
         (``Callback.needs_batch``)?  When nothing overrides, the engine
         skips the hook calls; when overriders all declare
-        ``needs_batch = False`` they are invoked with ``batch=None`` —
+        ``needs_batch = False`` at or below the class that defines the
+        overriding hook, they are invoked with ``batch=None`` —
         either way cached (especially shuffled) epochs never pay host
         collation for arguments nobody reads (the whole point of the
         cached path is removing per-step host work).  Detection goes
@@ -701,13 +702,32 @@ class Trainer:
             fn = getattr(cb, name, None)
             return getattr(fn, "__func__", fn) is not getattr(Callback, name)
 
+        def hook_needs_batch(cb, name):
+            # ``needs_batch`` counts only when declared at or below (as
+            # derived as) the definition of the overriding hook.  A user
+            # subclass of a needs_batch=False callback that overrides a
+            # batch hook without restating the flag gets the
+            # conservative default (True) — its new hook body may well
+            # read the batch the base class promised to ignore.
+            if "needs_batch" in vars(cb):
+                return vars(cb)["needs_batch"]     # instance: most derived
+            if name in vars(cb):                   # instance-assigned hook
+                return True                        # outranks any class flag
+            mro = type(cb).__mro__
+            hook_at = next(
+                (i for i, k in enumerate(mro) if name in vars(k)), len(mro))
+            for k in mro[:hook_at + 1]:
+                if "needs_batch" in vars(k):
+                    return vars(k)["needs_batch"]
+            return True
+
         invoke = materialize = False
         for cb in self.callbacks:
-            if overrides(cb, "on_train_batch_start") \
-                    or overrides(cb, "on_train_batch_end"):
-                invoke = True
-                if getattr(cb, "needs_batch", True):
-                    materialize = True
+            for name in ("on_train_batch_start", "on_train_batch_end"):
+                if overrides(cb, name):
+                    invoke = True
+                    if hook_needs_batch(cb, name):
+                        materialize = True
         return invoke, materialize
 
     def _engine_one(self, module, source, item) -> None:

@@ -32,6 +32,7 @@ from __future__ import annotations
 import functools
 import math
 import os
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -209,8 +210,14 @@ def _sub_block(t: int, causal: bool) -> int:
         return 0
     env = os.environ.get("RLT_FLASH_SUB")
     if env:   # empty string falls through to the default (cf. RLT_FLASH_BLOCK_Q)
-        s = int(env)
-        return s if s > 0 and t % s == 0 and s < t else 0
+        try:
+            s = int(env)
+        except ValueError:
+            warnings.warn(
+                f"RLT_FLASH_SUB={env!r} is not an integer; using the "
+                "default staircase sub-block (set 0 to disable)")
+        else:
+            return s if s > 0 and t % s == 0 and s < t else 0
     return 256 if t % 256 == 0 and t >= 512 else 0
 
 
@@ -643,16 +650,24 @@ def _bwd_tri_packed(q, k, v, h, lse, do, delta, sm_scale, bq, nq,
 # the rowres forward with the grid-tri backward.
 
 
-def _use_row_resident(t: int) -> bool:
-    return t <= 2048 and os.environ.get("RLT_FLASH_ROWRES", "1") != "0"
+def _use_row_resident(t: int, w: int = 128) -> bool:
+    """Backward engagement: the fp32 [T, w] dk/dv accumulators plus the
+    resident k/v scale with t·w, so the budget is the measured t=2048
+    point AT w=128 — wide heads (d ≥ 256 pack to w=d) hit the same
+    VMEM ceiling at proportionally shorter t."""
+    return t * w <= 2048 * 128 \
+        and os.environ.get("RLT_FLASH_ROWRES", "1") != "0"
 
 
-def _use_row_resident_fwd(t: int) -> bool:
+def _use_row_resident_fwd(t: int, w: int = 128) -> bool:
     """The forward kernel carries no fp32 [T,128] accumulators (online
     softmax lives in registers), so its VMEM budget stretches to
     T=8192 (measured −15%/−16% at 4096/8192 vs the grid-tri forward;
-    k/v residency is the win — loaded once per batch·head-group)."""
-    return t <= 8192 and os.environ.get("RLT_FLASH_ROWRES", "1") != "0"
+    k/v residency is the win — loaded once per batch·head-group).
+    The resident k/v are [T, w] each, so the budget caps t·w at the
+    measured w=128 point rather than t alone."""
+    return t * w <= 8192 * 128 \
+        and os.environ.get("RLT_FLASH_ROWRES", "1") != "0"
 
 
 def _fwd_rowres_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -894,11 +909,12 @@ def _fwd(q, k, v, h, causal, sm_scale, block_q, block_k, interpret):
     bk = _pick_block(t, block_k)
     nq, nk = t // bq, t // bk
 
-    if nq == 1 and nk == 1 and _head_pack(d, h):
+    pack = _head_pack(d, h)
+    if nq == 1 and nk == 1 and pack:
         return _fwd_packed(q, k, v, h, causal, sm_scale, interpret)
 
-    if _use_tri(causal, bq, bk, nq) and _head_pack(d, h):
-        if _use_row_resident_fwd(t):
+    if _use_tri(causal, bq, bk, nq) and pack:
+        if _use_row_resident_fwd(t, pack * d):
             return _fwd_rowres(q, k, v, h, sm_scale, bq, nq, interpret)
         return _fwd_tri_packed(q, k, v, h, sm_scale, bq, nq, interpret)
 
@@ -1370,7 +1386,7 @@ def _bwd(q, k, v, h, o, lse, do, causal, sm_scale, block_q, block_k,
                          * o.astype(jnp.float32)).reshape(b, t, h, d),
                         axis=-1)
         delta = delta.reshape(b, t, h // pack, pack).transpose(0, 2, 1, 3)
-        if _use_row_resident(t):
+        if _use_row_resident(t, pack * d):
             return _bwd_rowres(q, k, v, h, lse, do, delta, sm_scale,
                                bq, nq, interpret)
         return _bwd_tri_packed(q, k, v, h, lse, do, delta, sm_scale, bq,

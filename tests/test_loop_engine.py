@@ -226,3 +226,58 @@ def test_cached_unstable_indices_without_shuffle_flag():
     assert t_c.global_step == t_s.global_step
     np.testing.assert_allclose(r_c.losses, r_s.losses, rtol=1e-6,
                                atol=1e-6)
+
+
+def test_subclass_overriding_batch_hook_gets_real_batch():
+    """``needs_batch = False`` belongs to the class that declares it: a
+    user subclass that overrides a batch hook WITHOUT restating the flag
+    must receive the real batch (its new body may read it), while the
+    base class — and a subclass that restates False — keep batch=None
+    (ADVICE r4 #1: resolve needs_batch against the hook-defining class).
+    """
+    class QuietBase(Callback):
+        needs_batch = False     # this class's hook never reads the batch
+
+        def on_train_batch_end(self, trainer, module, outputs, batch,
+                               batch_idx):
+            pass
+
+    class NaiveSub(QuietBase):  # overrides, does not restate the flag
+        def __init__(self):
+            self.batches = []
+
+        def on_train_batch_end(self, trainer, module, outputs, batch,
+                               batch_idx):
+            self.batches.append(batch)
+
+    class DeclaredSub(NaiveSub):  # restates the promise at its own level
+        needs_batch = False
+
+    def fit(cb):
+        model = ShuffledBoring(False, n=8)
+        trainer = Trainer(max_epochs=1, enable_checkpointing=False,
+                          num_sanity_val_steps=0, limit_val_batches=0,
+                          logger=False, callbacks=[cb], seed=0,
+                          cache_train_dataset=True)
+        trainer.fit(model)
+
+    naive = NaiveSub()
+    fit(naive)
+    assert len(naive.batches) == 4
+    assert all(b is not None for b in naive.batches)
+
+    declared = DeclaredSub()
+    fit(declared)
+    assert len(declared.batches) == 4
+    assert all(b is None for b in declared.batches)
+
+    # instance-assigned hook on a needs_batch=False instance: the
+    # assignment is more derived than any class flag -> real batch
+    grabbed = []
+    patched = QuietBase()
+    patched.on_train_batch_end = (
+        lambda trainer, module, outputs, batch, idx:
+        grabbed.append(batch))
+    fit(patched)
+    assert len(grabbed) == 4
+    assert all(b is not None for b in grabbed)

@@ -265,6 +265,34 @@ def test_staircase_auto_threshold(monkeypatch):
     assert _sub_block(1024, False) == 0
 
 
+def test_staircase_env_malformed_warns_and_defaults(monkeypatch):
+    """A typo'd opt-out like RLT_FLASH_SUB=off must warn and fall back
+    to the auto default instead of crashing at trace time
+    (ADVICE r4 #4)."""
+    from ray_lightning_tpu.ops.flash_attention import _sub_block
+    monkeypatch.setenv("RLT_FLASH_SUB", "off")
+    with pytest.warns(UserWarning, match="RLT_FLASH_SUB"):
+        assert _sub_block(512, True) == 256   # the auto default
+    monkeypatch.setenv("RLT_FLASH_SUB", "")
+    assert _sub_block(512, True) == 256       # empty: silent default
+
+
+def test_rowres_gates_factor_head_width(monkeypatch):
+    """The row-resident VMEM budgets were measured at w=128; wide heads
+    (d >= 256 pack to w=d) must cap t·w, not t alone (ADVICE r4 #3)."""
+    from ray_lightning_tpu.ops.flash_attention import (
+        _use_row_resident, _use_row_resident_fwd)
+    monkeypatch.delenv("RLT_FLASH_ROWRES", raising=False)
+    assert _use_row_resident_fwd(8192, 128)        # the measured point
+    assert not _use_row_resident_fwd(8192, 256)    # 2x resident k/v
+    assert _use_row_resident_fwd(4096, 256)        # same t*w budget
+    assert _use_row_resident(2048, 128)
+    assert not _use_row_resident(2048, 256)
+    assert _use_row_resident(1024, 256)
+    monkeypatch.setenv("RLT_FLASH_ROWRES", "0")
+    assert not _use_row_resident_fwd(1024, 128)
+
+
 def test_staircase_non_causal_unaffected(monkeypatch):
     """Non-causal single block must ignore RLT_FLASH_SUB entirely."""
     monkeypatch.setenv("RLT_FLASH_SUB", "32")
@@ -320,7 +348,7 @@ def test_fwd_rowres_with_grid_tri_backward(monkeypatch):
     here."""
     import sys
     fa = sys.modules["ray_lightning_tpu.ops.flash_attention"]
-    monkeypatch.setattr(fa, "_use_row_resident", lambda t: False)
+    monkeypatch.setattr(fa, "_use_row_resident", lambda t, w=128: False)
     assert fa._use_row_resident_fwd(256)
     q, k, v = _rand_qkv(t=256, h=2, d=64)
 
