@@ -89,7 +89,11 @@ class StreamSource:
     issues this process's (async) per-device puts plus global
     metadata — no collective — so assembling batch k+1's global array
     early is safe as long as every process prefetches in the same
-    order, which the shared loader contract already guarantees; the
+    order, which the shared loader contract already guarantees (pinned
+    by tests/test_plugin_distributed.py: the RLT_STREAM_PREFETCH A/B is
+    loss-sequence identical across actors, and the divergent-order
+    canary shows a contract violation skews identically with prefetch
+    on or off — pairing is positional either way); the
     round-3 gate serialized link time with step time on exactly the
     path a real pod feeds with (VERDICT r3 weak #3).  Chunked dispatch
     keeps its own host-side stacking.

