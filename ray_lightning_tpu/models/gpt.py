@@ -57,10 +57,14 @@ class GPTConfig:
     # (jax.checkpoint_policies): "full" = nothing saveable (max memory
     # savings, max recompute); "dots" = keep matmul outputs (recompute
     # only the cheap elementwise chains); "dots_no_batch" = keep only
-    # batch-free matmul outputs (≈ params-shaped, tiny).  The policy is
-    # THE lever of the memory-bound regime — measured walk in
-    # benchmarks/README.md (gpt2-medium).  ``RLT_REMAT_POLICY``
-    # overrides at model build for A/B sweeps.
+    # batch-free matmul outputs (≈ params-shaped, tiny);
+    # "dots_moe_act" / "dots_moe" = dots plus the named MoE
+    # intermediates (ops/moe.py checkpoint_names — measured SLOWER than
+    # plain dots on gpt2-moe-8e, kept as documented options);
+    # "off" = save everything.  The policy is THE lever of the
+    # memory-bound regime — measured walk in benchmarks/README.md
+    # (gpt2-medium).  ``RLT_REMAT_POLICY`` overrides at model build for
+    # A/B sweeps.
     remat_policy: str = "full"
     dtype: Any = jnp.bfloat16        # compute dtype; params stay fp32
     # "auto" | "dot" | "flash" | "ring" | "local" (ops/attention.py;
@@ -162,14 +166,25 @@ def _remat_policy(name: str):
     """jax.checkpoint policy for a config/env name (None = save nothing,
     jax's default — the max-recompute end of the walk)."""
     name = os.environ.get("RLT_REMAT_POLICY") or name
+    cp = jax.checkpoint_policies
     policies = {
         "full": None,
-        "dots": jax.checkpoint_policies.dots_saveable,
-        "dots_no_batch":
-            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "dots": cp.dots_saveable,
+        "dots_no_batch": cp.dots_with_no_batch_dims_saveable,
+        # dots + the named MoE intermediates (ops/moe.py checkpoint_name):
+        # gelu output / dispatch / combine live between dots and off —
+        # saving them keeps the expert backward's dgrad fusions off the
+        # recompute chains that drag them bandwidth-bound, without
+        # round-tripping EVERY intermediate the way "off" does
+        "dots_moe_act": cp.save_from_both_policies(
+            cp.dots_saveable, cp.save_only_these_names("moe_hact")),
+        "dots_moe": cp.save_from_both_policies(
+            cp.dots_saveable,
+            cp.save_only_these_names("moe_hact", "moe_dispatch",
+                                     "moe_combine")),
         # saves every intermediate == remat disabled in effect; the
         # no-recompute endpoint of the policy walk
-        "off": jax.checkpoint_policies.everything_saveable,
+        "off": cp.everything_saveable,
     }
     if name not in policies:
         raise ValueError(

@@ -86,6 +86,11 @@ class MoEMLP(nn.Module):
         # Fill expert slots choice-by-choice; the per-expert position
         # counter carries across choices so a token's 2nd-choice expert
         # sees slots already taken by other tokens' 1st choices.
+        # (A compute-dtype [G,S,E,cap] chain was tried in round 5 —
+        # exact by disjointness — and measured 80.08 ms/step, identical
+        # to this fp32 chain: the expert-bwd drag fusions' bytes are
+        # einsum operand traffic, not chain dtype; see the README's
+        # round-5 MoE rejected-experiment note.)
         dispatch = jnp.zeros((G, S, E, capacity), dtype=x.dtype)
         combine = jnp.zeros((G, S, E, capacity), dtype=jnp.float32)
         taken = jnp.zeros((G, 1, E), dtype=jnp.int32)
@@ -112,12 +117,25 @@ class MoEMLP(nn.Module):
 
         # dispatch → expert FFN → combine: three MXU einsums.  With w1/w2
         # sharded on the expert axis and tokens on data, GSPMD inserts the
-        # token all-to-all around the FFN automatically.
+        # token all-to-all around the FFN automatically.  The big
+        # intermediates carry checkpoint_names so remat policies can save
+        # them selectively (models/gpt.py "dots_moe_act"/"dots_moe") —
+        # measured round 5: BOTH save-lists lose to plain "dots"
+        # (81.97 / 83.12 vs 80.08 ms/step; the HBM round-trip of the
+        # saved tensors exceeds the recompute it removes), so they exist
+        # as documented rejected options, not defaults.
+        from jax.ad_checkpoint import checkpoint_name as name
+        dispatch = name(dispatch, "moe_dispatch")
         xe = jnp.einsum("gsec,gsm->egcm", dispatch, x)
         h = jnp.einsum("egcm,emh->egch", xe, w1.astype(self.dtype))
-        h = nn.gelu(h)
+        h = name(nn.gelu(h), "moe_hact")
         out = jnp.einsum("egch,ehm->egcm", h, w2.astype(self.dtype))
-        return jnp.einsum("gsec,egcm->gsm", combine.astype(self.dtype), out)
+        # the tag sits on the bf16-cast combine (the tensor the einsum
+        # consumes), not the fp32 original — saving double-width bytes
+        # would pessimize the save-list option for no consumer
+        return jnp.einsum("gsec,egcm->gsm",
+                          name(combine.astype(self.dtype), "moe_combine"),
+                          out)
 
 
 def moe_partition_rules(expert_axis: str = "expert",
