@@ -28,6 +28,7 @@ import logging
 import os
 import tempfile
 import time
+import warnings
 from typing import Any, Optional
 
 import fsspec
@@ -169,6 +170,7 @@ class Trainer:
         self.state: Optional[TrainState] = None
         self._world = {"world_size": 1, "global_rank": 0, "local_rank": 0,
                        "node_rank": 0}
+        self._cache_bytes_hint = None
         self._mesh = None
         self._epoch_metric_acc: dict[str, list] = {}
         self._warned_skip = False
@@ -306,6 +308,10 @@ class Trainer:
         self._mesh = strategy.build_mesh(self.plugin.local_devices(),
                                          batch_hint=batch_hint)
         set_current_mesh(self._mesh)  # for mesh-aware ops (ring attention)
+        self._cache_bytes_hint = (
+            _cache_bytes_estimate(loaders.get("train"), example_batch,
+                                  self.limit_train_batches)
+            if stage == "fit" and self.cache_train_dataset else 0)
         self._build_compiled(module, example_batch, strategy)
         self._init_state(module, example_batch, strategy, ckpt_path)
 
@@ -362,6 +368,85 @@ class Trainer:
                 optax.clip_by_global_norm(self.gradient_clip_val), tx)
         return tx
 
+    # HBM per chip for device kinds whose runtime reports no
+    # memory_stats (the axon tunnel returns None); donation falls back
+    # to ON for unknown kinds, so a missing entry is safe, not wrong
+    _HBM_BY_KIND = {
+        "TPU v4": 32 << 30,
+        "TPU v5 lite": 16 << 30,
+        "TPU v5e": 16 << 30,
+        "TPU v5": 95 << 30,      # v5p
+        "TPU v5p": 95 << 30,
+        "TPU v6 lite": 32 << 30,
+        "TPU v6e": 32 << 30,
+    }
+
+    def _device_memory_budget(self) -> "int | None":
+        dev = self._mesh.devices.flat[0]
+        try:
+            stats = dev.memory_stats()
+            if stats and "bytes_limit" in stats:
+                return int(stats["bytes_limit"])
+        except Exception:
+            pass
+        if getattr(dev, "platform", None) == "tpu":
+            return self._HBM_BY_KIND.get(getattr(dev, "device_kind", ""))
+        return None
+
+    def _should_donate(self, abstract, shardings) -> bool:
+        """Donate the TrainState into the step only when memory needs it.
+
+        Donation (in-place state update) halves peak state residency —
+        required for the large configs (the 1.3B fit audits assume it) —
+        but it CONSTRAINS XLA's scheduling: the round-5 A/B measured the
+        identical gpt2-small program at 51.08 ms/step donated vs
+        49.35 ms un-donated on v5e, and BERT at 91.59 vs 90.24.  The
+        win does NOT extend up the size axis: gpt2-moe-8e (state
+        ~3.6 GB, ~22% of v5e HBM) measured 81.85 un-donated vs 80.08
+        donated — so auto skips donation only for SMALL states (the
+        measured win region: state ≤ ~10% of the budget, the 0.3/2.5
+        factors below put the v5e cut at ~1.9 GB, between BERT's win
+        and MoE's loss), and donates whenever the budget is unknown
+        (virtual CPU meshes, profiler-less backends) — the conservative
+        default that keeps every fit audit valid.
+        ``RLT_DONATE=1``/``0`` forces either way.
+        """
+        env = os.environ.get("RLT_DONATE", "").strip()
+        if env in ("0", "1"):
+            return env == "1"
+        if env:
+            warnings.warn(
+                f"RLT_DONATE={env!r} is neither '0' nor '1'; using the "
+                "auto heuristic")
+        limit = self._device_memory_budget()
+        if limit is None:
+            return True
+        if self.cache_train_dataset:
+            # the device-resident dataset cache shares the budget; debit
+            # a conservative (un-sharded) estimate, and donate outright
+            # when the cache size cannot be bounded up front
+            hint = self._cache_bytes_hint
+            if hint is None:
+                return True
+            limit -= hint
+        state_bytes = 0
+        leaves = jax.tree_util.tree_leaves(abstract)
+        shs = jax.tree_util.tree_leaves(
+            shardings, is_leaf=lambda x: hasattr(x, "spec"))
+        if len(shs) != len(leaves):
+            return True     # unrecognized shardings tree: stay safe
+        for aval, sh in zip(leaves, shs):
+            shape = sh.shard_shape(aval.shape) \
+                if hasattr(sh, "shard_shape") else aval.shape
+            state_bytes += int(np.prod(shape, dtype=np.int64)) \
+                * aval.dtype.itemsize
+        # un-donated peak carries old+new state (2x) on top of the
+        # activations/grads the donated program also needs; the 0.3
+        # ceiling both keeps the skip far from any OOM edge and encodes
+        # the MEASURED win boundary (small states win, ~22%-of-HBM
+        # states lose — see docstring)
+        return not (2.5 * state_bytes < 0.3 * limit)
+
     def _build_compiled(self, module, example_batch, strategy):
         self._tx = self._configure_tx(module)
         self._init_fn = build_init_fn(module, self._tx)
@@ -380,7 +465,9 @@ class Trainer:
         # measured 30x slower on remote TPU tunnels — so on single-device
         # meshes the batch stays unconstrained and takes the fast default
         # transfer path.)
-        jit_kwargs = dict(donate_argnums=0, out_shardings=(shardings, None))
+        donate = self._should_donate(abstract, shardings)
+        dkw = {"donate_argnums": 0} if donate else {}
+        jit_kwargs = dict(out_shardings=(shardings, None), **dkw)
         batch_sh = None
         if self._mesh.devices.size > 1:
             batch_sh = strategy.batch_shardings(self._mesh, example_batch)
@@ -407,7 +494,7 @@ class Trainer:
                 # k steps as one XLA program; metrics stack to [k, ...]
                 return jax.lax.scan(step_fn, state, batches)
 
-            mkw = dict(donate_argnums=0, out_shardings=(shardings, None))
+            mkw = dict(out_shardings=(shardings, None), **dkw)
             if self._stacked_batch_shardings is not None:
                 mkw["in_shardings"] = (shardings,
                                        self._stacked_batch_shardings)
@@ -431,8 +518,7 @@ class Trainer:
             def cached_single(state, dataset, i):
                 return step_fn(state, gather(dataset, i))
 
-            ckw = dict(donate_argnums=0,
-                       out_shardings=(shardings, None))
+            ckw = dict(out_shardings=(shardings, None), **dkw)
             if self._stacked_batch_shardings is not None:
                 ckw["in_shardings"] = (
                     shardings, self._stacked_batch_shardings, None)
@@ -1173,6 +1259,23 @@ class _ShardedStepCache:
                 jitted = jax.jit(self._fn)
             self._cache[key] = jitted
         return jitted(state, batch)
+
+
+def _cache_bytes_estimate(loader, example_batch, limit) -> "int | None":
+    """Upper-bound bytes of the device-resident train cache (per batch ×
+    batch count), for the donation heuristic's budget debit.  None when
+    the loader has no length (the same loaders the cache itself refuses,
+    core/loop_engine.py) — the caller then donates, the safe default."""
+    try:
+        n = len(loader)
+    except TypeError:
+        return None
+    if limit is not None:
+        n = min(n, int(limit))
+    batch_bytes = sum(
+        int(getattr(leaf, "nbytes", 0) or np.asarray(leaf).nbytes)
+        for leaf in jax.tree_util.tree_leaves(example_batch))
+    return n * batch_bytes
 
 
 def _peek_first_batch(loader):

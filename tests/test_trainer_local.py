@@ -385,3 +385,65 @@ def test_uses_rng_false_with_grad_accumulation(tmp_path, seed):
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32),
                                    rtol=1e-5, atol=1e-6)
+
+
+# -- conditional state donation (round 5) -----------------------------------
+
+
+def test_donation_is_perf_only(tmp_path, seed, monkeypatch):
+    """RLT_DONATE=0 vs 1 must produce IDENTICAL training runs — donation
+    is buffer aliasing, never math (the round-5 heuristic skips it on
+    small states for the measured ~3% device win; this is the guard
+    that the knob can never change results)."""
+    from ray_lightning_tpu.core.callbacks import Callback
+
+    def run(flag):
+        monkeypatch.setenv("RLT_DONATE", flag)
+        traj = []
+
+        class Track(Callback):
+            def on_train_batch_end(self, trainer, module, outputs, batch,
+                                   idx):
+                traj.append(float(np.asarray(outputs["loss"]).ravel()[-1]))
+
+        t = get_trainer(str(tmp_path / f"d{flag}"), max_epochs=1,
+                        limit_train_batches=6, limit_val_batches=0,
+                        checkpoint=False, callbacks=[Track()])
+        t.fit(BoringModel(lr=0.05))
+        return traj
+
+    np.testing.assert_allclose(run("1"), run("0"), rtol=0, atol=0,
+                               err_msg="donation changed training math")
+
+
+def test_should_donate_heuristic(tmp_path, seed, monkeypatch):
+    """Auto mode donates when the device budget is unknown (virtual CPU
+    meshes — keeps every memory-fit audit valid); RLT_DONATE forces
+    either way; a typo'd value warns and falls through to auto; an
+    unbounded dataset cache forces donation even under a known budget
+    (the cache shares the HBM the skip would spend)."""
+    t = get_trainer(str(tmp_path), checkpoint=False)
+    t.fit(BoringModel())          # builds _mesh/_abstract_state
+    abstract = t._abstract_state
+    sh = t._state_shardings
+    monkeypatch.delenv("RLT_DONATE", raising=False)
+    assert t._should_donate(abstract, sh)       # CPU: budget unknown
+    monkeypatch.setenv("RLT_DONATE", "0")
+    assert not t._should_donate(abstract, sh)
+    monkeypatch.setenv("RLT_DONATE", "1")
+    assert t._should_donate(abstract, sh)
+    monkeypatch.setenv("RLT_DONATE", "yes")
+    with pytest.warns(UserWarning, match="RLT_DONATE"):
+        assert t._should_donate(abstract, sh)   # auto on CPU: donate
+    # known budget + small state -> skip; unbounded cache -> donate
+    monkeypatch.delenv("RLT_DONATE", raising=False)
+    monkeypatch.setattr(type(t), "_device_memory_budget",
+                        lambda self: 16 << 30)
+    assert not t._should_donate(abstract, sh)   # tiny state, no cache
+    t.cache_train_dataset = True
+    t._cache_bytes_hint = None
+    assert t._should_donate(abstract, sh)       # cache size unknown
+    t._cache_bytes_hint = 16 << 30
+    assert t._should_donate(abstract, sh)       # cache exhausts the budget
+    t._cache_bytes_hint = 1 << 20
+    assert not t._should_donate(abstract, sh)   # small cache: still skip
