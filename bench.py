@@ -4,7 +4,11 @@ attached (one TPU chip under the driver; CPU elsewhere).
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": "steps/sec", "vs_baseline": N,
-   "device_ms": M}
+   "device_ms": M, "telemetry_jsonl": "<path>"}
+
+``telemetry_jsonl`` points at the run's exported span/counter stream
+(telemetry/): BENCH rounds can attribute a regression to a phase
+(step vs data_wait vs compile) straight from the recorded spans.
 
 ``value`` is wall steps/sec (the BASELINE.md bar as specified);
 ``device_ms`` is the median device time of the compiled train step

@@ -21,7 +21,8 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
                       timed: int = 30, baseline: "float | None" = None,
                       strategy=None, trainer_kwargs=None,
                       trace_steps: int = 0,
-                      inline_device_ms: bool = False) -> dict:
+                      inline_device_ms: bool = False,
+                      telemetry: bool = True) -> dict:
     """Time steady-state steps; optionally profile a WARM tail.
 
     ``trace_steps > 0``: after the timed window closes (and its sync
@@ -36,6 +37,12 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
     as ``device_ms`` — the tunnel-immune number of record alongside the
     wall steps/sec, which swings ±3-5% with host-link state that has
     nothing to do with the framework.  The trace dir is consumed.
+
+    ``telemetry`` (default on): run with the framework telemetry layer
+    enabled and report the exported ``telemetry.jsonl`` path as
+    ``telemetry_jsonl`` in the JSON line, so a BENCH regression can be
+    attributed to a phase (step vs data_wait vs compile) from the span
+    stream instead of re-running under a profiler.
     """
     from ray_lightning_tpu import Trainer
     from ray_lightning_tpu.core.callbacks import Callback
@@ -103,7 +110,7 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
         strategy=strategy,
         enable_checkpointing=False, num_sanity_val_steps=0,
         limit_val_batches=0, log_every_n_steps=10**9, callbacks=[timer],
-        seed=0, **(trainer_kwargs or {}))
+        seed=0, telemetry=bool(telemetry), **(trainer_kwargs or {}))
     trainer.fit(module)
     assert timer.elapsed is not None, "did not reach timed steps"
     steps_per_sec = timer.steps / timer.elapsed
@@ -113,6 +120,9 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
         "unit": "steps/sec",
         "vs_baseline": round(steps_per_sec / (baseline or steps_per_sec), 3),
     }
+    paths = getattr(trainer, "_telemetry_paths", None)
+    if paths:
+        result["telemetry_jsonl"] = paths["jsonl"]
     if inline_device_ms and timer.trace_dir is not None:
         from benchmarks import trace_tools
         med = trace_tools.dominant_module_ms_or_none(timer.trace_dir)

@@ -4,15 +4,32 @@
 # invocation (.github/workflows/test.yaml lint job).
 #
 # Usage:
-#   ./format.sh          # lint files changed vs the merge-base with main
-#   ./format.sh --all    # lint the whole tree
+#   ./format.sh                  # lint files changed vs the merge-base with main
+#   ./format.sh --all            # lint the whole tree
+#   ./format.sh --check [--all]  # explicit non-mutating check mode for CI:
+#                                # guaranteed to touch no files, exits nonzero
+#                                # on findings (same lint; the flag exists so
+#                                # CI stays correct if a mutating formatter is
+#                                # ever added to the default path)
 
 set -euo pipefail
 cd "$(dirname "$0")"
 
 FLAKE8_ARGS=(--max-line-length=88 --extend-ignore=E203,W503)
 
-if [[ "${1:-}" == "--all" ]]; then
+CHECK=0
+ALL=0
+for arg in "$@"; do
+    case "$arg" in
+        --check) CHECK=1 ;;
+        --all)   ALL=1 ;;
+        *) echo "usage: $0 [--check] [--all]" >&2; exit 2 ;;
+    esac
+done
+# --check is non-mutating by construction: only flake8 runs below.
+: "$CHECK"
+
+if [[ "$ALL" == 1 ]]; then
     exec flake8 "${FLAKE8_ARGS[@]}" ray_lightning_tpu tests benchmarks bench.py __graft_entry__.py
 fi
 

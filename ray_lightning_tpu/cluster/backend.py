@@ -60,6 +60,11 @@ class ActorHandle:
     def kill(self) -> None:
         raise NotImplementedError
 
+    def alive(self) -> Optional[bool]:
+        """Cheap liveness probe for watchdog diagnostics (telemetry/):
+        True/False when the backend can tell, None when it cannot."""
+        return None
+
 
 class ClusterBackend:
     """Actor lifecycle + object transport + worker→driver queue."""

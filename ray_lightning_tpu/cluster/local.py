@@ -24,6 +24,7 @@ import subprocess
 import sys
 import tempfile
 import threading
+import time
 import uuid
 from typing import Any, Optional
 
@@ -90,6 +91,9 @@ class LocalActorHandle(ActorHandle):
         self._lock = threading.Lock()
         self._dead = False
         self._death_error: Optional[BaseException] = None
+        #: monotonic time of the last frame received from this worker
+        #: (any type, heartbeats included) — watchdog/failure forensics
+        self.last_frame_at: Optional[float] = None
 
     def _log_tail(self, max_bytes: int = 4096) -> str:
         """Tail of the worker's captured output, for failure diagnostics
@@ -120,6 +124,7 @@ class LocalActorHandle(ActorHandle):
         try:
             while True:
                 msg = self._conn.recv()
+                self.last_frame_at = time.monotonic()
                 kind = msg.get("type")
                 if kind == "result":
                     with self._lock:
@@ -138,12 +143,15 @@ class LocalActorHandle(ActorHandle):
                 elif kind == "queue":
                     self._backend._queue_push(msg["item"])
         except (ConnectionError, OSError):
+            silent = (f"; last frame "
+                      f"{time.monotonic() - self.last_frame_at:.1f}s ago"
+                      if self.last_frame_at is not None else "")
             self._fail_pending(
                 RemoteActorError(
                     f"actor {self.actor_id} died (connection lost); "
                     f"returncode="
                     f"{self._proc.poll() if self._proc else 'unknown'}"
-                    f"{self._log_tail()}"))
+                    f"{silent}{self._log_tail()}"))
 
     def _fail_pending(self, err: BaseException) -> None:
         self._dead = True
@@ -179,6 +187,11 @@ class LocalActorHandle(ActorHandle):
         except (ConnectionError, OSError) as e:
             self._fail_pending(RemoteActorError(str(e)))
         return fut
+
+    def alive(self) -> Optional[bool]:
+        if self._proc is None:
+            return None
+        return self._proc.poll() is None
 
     def kill(self) -> None:
         """Hard-stop the actor (``ray.kill(no_restart=True)`` analog,

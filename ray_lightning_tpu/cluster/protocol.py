@@ -11,6 +11,13 @@ play for the reference, SURVEY.md §2.2).  Messages are dicts with a
   worker→driver: {type: hello, actor_id}
                  {type: result, call_id, ok, value|error}
                  {type: queue, item}         (unsolicited, session relay)
+
+``queue`` frames carry two item families: user session relays (Tune
+reports/checkpoints — callables executed on the driver) and telemetry
+items (span batches + heartbeats, dicts marked with
+``telemetry.TELEMETRY_KEY``) routed to the driver-side aggregator by
+``util.process_results``.  Heartbeats ride this same channel so worker
+liveness needs no second socket.
 """
 
 from __future__ import annotations

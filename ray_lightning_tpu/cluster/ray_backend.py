@@ -126,6 +126,18 @@ class RayActorHandle(ActorHandle):
     def kill(self) -> None:
         ray.kill(self._actor, no_restart=True)
 
+    def alive(self) -> Optional[bool]:
+        """Liveness probe via the executor's ``ping`` (watchdog
+        diagnostics).  Bounded wait: a wedged-but-alive actor that
+        cannot answer within 2s reads as not-alive, which is exactly
+        what the watchdog wants to report."""
+        try:
+            ref = self._actor.ping.remote()
+            ready, _ = ray.wait([ref], timeout=2.0)
+            return bool(ready)
+        except Exception:
+            return False
+
 
 class RayBackend(ClusterBackend):
     supports_object_store = True

@@ -42,6 +42,19 @@ def main() -> int:
     _conn.send({"type": "hello", "actor_id": actor_id})
     _trace("hello sent")
 
+    if os.environ.get("RLT_TELEMETRY") == "1":
+        # process-level heartbeats over the queue channel, from BEFORE
+        # any heavy import: a worker that wedges during jax/libtpu init
+        # is already visible to the driver watchdog.  Rank is re-read
+        # from RLT_PROCESS_ID per beat (assigned after spawn).
+        from ray_lightning_tpu.telemetry.heartbeat import (
+            start_process_heartbeat)
+        start_process_heartbeat(
+            worker_state.queue_send,
+            interval=float(os.environ.get("RLT_HEARTBEAT_INTERVAL", "5")),
+            actor_id=actor_id)
+        _trace("heartbeats started")
+
     with open(spec_path, "rb") as f:
         actor_cls, args, kwargs = cloudpickle.loads(f.read())
     try:

@@ -43,6 +43,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_lightning_tpu.telemetry import span
+
 _log = logging.getLogger(__name__)
 
 
@@ -114,21 +116,25 @@ class StreamSource:
     def _pull(self) -> "Item | None":
         """One acceptable batch from the loader, honoring
         ``limit_train_batches`` (which counts loader POSITIONS, not
-        accepted batches — the contract shared by every dispatch path)."""
+        accepted batches — the contract shared by every dispatch path).
+        The ``data_wait`` span is the host-side input-pipeline cost per
+        batch — when it rivals the step span, the loader is the
+        bottleneck."""
         t = self._trainer
-        while not self.exhausted:
-            try:
-                batch_idx, batch = next(self._it)
-            except StopIteration:
-                self.exhausted = True
-                return None
-            if t.limit_train_batches is not None \
-                    and batch_idx >= t.limit_train_batches:
-                self.exhausted = True
-                return None
-            if t._batch_ok(batch, self._strategy):
-                return Item(batch_idx=batch_idx, kind="host",
-                            payload=batch)
+        with span("data_wait"):
+            while not self.exhausted:
+                try:
+                    batch_idx, batch = next(self._it)
+                except StopIteration:
+                    self.exhausted = True
+                    return None
+                if t.limit_train_batches is not None \
+                        and batch_idx >= t.limit_train_batches:
+                    self.exhausted = True
+                    return None
+                if t._batch_ok(batch, self._strategy):
+                    return Item(batch_idx=batch_idx, kind="host",
+                                payload=batch)
         return None
 
     def _start_transfer(self, item: Item) -> None:
@@ -428,7 +434,8 @@ class CachedSource:
                 if not self.build():   # pragma: no cover — build
                     raise RuntimeError(  # succeeded once already
                         "cache_train_dataset: flat cache re-upload failed")
-            self._repacked = self._repack_jit(self._flat, perm)
+            with span("repack", epoch=t.current_epoch):
+                self._repacked = self._repack_jit(self._flat, perm)
             self._last_perm = perm
             if not getattr(loader, "shuffle", False) \
                     and not self._promise_broken:

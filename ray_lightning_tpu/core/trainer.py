@@ -49,6 +49,7 @@ from ray_lightning_tpu.core.steps import (
 from ray_lightning_tpu.parallel.gather import fetch_tree
 from ray_lightning_tpu.parallel.mesh import set_current_mesh
 from ray_lightning_tpu.parallel.strategy import resolve_strategy
+from ray_lightning_tpu.telemetry import TelemetryConfig, span
 from ray_lightning_tpu.utils.seed import reset_seed, seed_everything
 
 _log = logging.getLogger(__name__)
@@ -97,6 +98,7 @@ class Trainer:
         use_distributed_sampler: bool = True,
         enable_progress_bar: bool = False,   # accepted for API parity
         logger: Any = True,                  # accepted for API parity
+        telemetry: Any = None,
     ):
         if max_epochs is None and (max_steps is None or max_steps < 0):
             max_epochs = 1000
@@ -140,6 +142,14 @@ class Trainer:
         self.seed = seed
         self.resume_from_checkpoint = resume_from_checkpoint
         self.use_distributed_sampler = use_distributed_sampler
+        # run telemetry (telemetry/): per-rank spans + heartbeats stream
+        # to the driver, which exports trace.json / telemetry.jsonl.
+        # None defers to RLT_TELEMETRY; the config pickles to workers
+        # with the trainer.
+        self.telemetry = TelemetryConfig.resolve(telemetry)
+        #: exported artifact paths, set by the execution plugin after a
+        #: telemetry-enabled run ({"trace": ..., "jsonl": ..., "summary"})
+        self._telemetry_paths: Optional[dict] = None
         from ray_lightning_tpu.utils.logger import resolve_logger
         self.logger = resolve_logger(logger, self.default_root_dir)
 
@@ -312,8 +322,13 @@ class Trainer:
             _cache_bytes_estimate(loaders.get("train"), example_batch,
                                   self.limit_train_batches)
             if stage == "fit" and self.cache_train_dataset else 0)
-        self._build_compiled(module, example_batch, strategy)
-        self._init_state(module, example_batch, strategy, ckpt_path)
+        # "compile" covers trace construction + jit setup; the first
+        # "step" span additionally contains the XLA compile of the train
+        # program (jax compiles lazily at first dispatch)
+        with span("compile"):
+            self._build_compiled(module, example_batch, strategy)
+        with span("init"):
+            self._init_state(module, example_batch, strategy, ckpt_path)
 
         for cb in self.callbacks:
             cb.setup(self, module, stage)
@@ -822,7 +837,8 @@ class Trainer:
             batch = item.batch() if want_batch else None
             for cb in self.callbacks:
                 cb.on_train_batch_start(self, module, batch, item.batch_idx)
-        metrics = source.run_one(self, item)
+        with span("step", step=self.global_step):
+            metrics = source.run_one(self, item)
         self.global_step += 1
         self._accumulate_metrics(metrics)
         if self.global_step % self.log_every_n_steps == 0:
@@ -844,7 +860,10 @@ class Trainer:
                         self, module, it.batch() if want_batch else None,
                         it.batch_idx)
         before = self.global_step
-        metrics = source.run_chunk(self, items)
+        # k steps ride one span; the aggregator normalizes per-step time
+        # by the "k" attribute when computing percentiles
+        with span("step", step=before, k=len(items)):
+            metrics = source.run_chunk(self, items)
         self.global_step += len(items)
         self._accumulate_metrics(metrics)
         self._publish_if_crossed(before, jax.tree_util.tree_map(
@@ -937,21 +956,22 @@ class Trainer:
                 cb.on_test_start(self, module)
 
         acc: list[tuple[dict, int]] = []
-        for batch_idx, batch in enumerate(loader):
-            if limit is not None and batch_idx >= limit:
-                break
-            if not self._batch_ok(batch, strategy):
-                continue
-            gbatch = self._put_batch(batch, strategy)
-            logged = step(self.state, gbatch)
-            leaves = jax.tree_util.tree_leaves(batch)
-            bsz = leaves[0].shape[0] if leaves and getattr(
-                leaves[0], "ndim", 0) > 0 else 1
-            acc.append((logged, bsz))
-            if stage == "validate":
-                for cb in self.callbacks:
-                    cb.on_validation_batch_end(self, module, logged, batch,
-                                               batch_idx)
+        with span("eval", stage=stage):
+            for batch_idx, batch in enumerate(loader):
+                if limit is not None and batch_idx >= limit:
+                    break
+                if not self._batch_ok(batch, strategy):
+                    continue
+                gbatch = self._put_batch(batch, strategy)
+                logged = step(self.state, gbatch)
+                leaves = jax.tree_util.tree_leaves(batch)
+                bsz = leaves[0].shape[0] if leaves and getattr(
+                    leaves[0], "ndim", 0) > 0 else 1
+                acc.append((logged, bsz))
+                if stage == "validate":
+                    for cb in self.callbacks:
+                        cb.on_validation_batch_end(self, module, logged,
+                                                   batch, batch_idx)
 
         means: dict[str, float] = {}
         if acc:
@@ -1084,21 +1104,22 @@ class Trainer:
         """Collective: every process participates in the gather; only
         global-zero writes (fsspec so GCS paths work on pods —
         SURVEY.md §7 best-path/locality hazard)."""
-        ckpt = self.dump_checkpoint()
-        if self.is_global_zero:
-            payload = self.serialize_checkpoint(ckpt)
-            dirname = os.path.dirname(filepath)
-            if dirname and "://" not in filepath:
-                os.makedirs(dirname, exist_ok=True)
-            # atomic-ish local write; remote filesystems via fsspec
-            if "://" in filepath:
-                with fsspec.open(filepath, "wb") as f:
-                    f.write(payload)
-            else:
-                fd, tmp = tempfile.mkstemp(dir=dirname or ".")
-                with os.fdopen(fd, "wb") as f:
-                    f.write(payload)
-                os.replace(tmp, filepath)
+        with span("checkpoint", step=self.global_step):
+            ckpt = self.dump_checkpoint()
+            if self.is_global_zero:
+                payload = self.serialize_checkpoint(ckpt)
+                dirname = os.path.dirname(filepath)
+                if dirname and "://" not in filepath:
+                    os.makedirs(dirname, exist_ok=True)
+                # atomic-ish local write; remote filesystems via fsspec
+                if "://" in filepath:
+                    with fsspec.open(filepath, "wb") as f:
+                        f.write(payload)
+                else:
+                    fd, tmp = tempfile.mkstemp(dir=dirname or ".")
+                    with os.fdopen(fd, "wb") as f:
+                        f.write(payload)
+                    os.replace(tmp, filepath)
 
     def save_sharded_checkpoint(self, directory: str,
                                 step: Optional[int] = None,

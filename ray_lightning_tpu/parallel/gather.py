@@ -17,6 +17,8 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ray_lightning_tpu.telemetry import span
+
 
 def _replicate_leaves(leaves: list) -> list:
     """All-gather non-addressable leaves to full replication in ONE jitted
@@ -27,7 +29,16 @@ def _replicate_leaves(leaves: list) -> list:
 
 
 def fetch_tree(tree: Any) -> Any:
-    """Pytree of global jax.Arrays → pytree of full host numpy arrays."""
+    """Pytree of global jax.Arrays → pytree of full host numpy arrays.
+
+    The ``collective`` span times the all-gather + host transfer — the
+    cross-host cost of checkpoints and result streams, visible per rank
+    in the telemetry timeline."""
+    with span("collective", op="fetch_tree"):
+        return _fetch_tree(tree)
+
+
+def _fetch_tree(tree: Any) -> Any:
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     pending = [i for i, l in enumerate(leaves)
                if isinstance(l, jax.Array) and not l.is_fully_addressable]

@@ -40,7 +40,26 @@ class LocalPlugin(ExecutionPlugin):
     def run(self, trainer, module, datamodule, stage, ckpt_path):
         if self.strategy is None:
             self.strategy = resolve_strategy(None)
-        return trainer._run_stage(module, datamodule, stage, ckpt_path)
+        cfg = getattr(trainer, "telemetry", None)
+        if cfg is None or not cfg.enabled:
+            return trainer._run_stage(module, datamodule, stage, ckpt_path)
+        # single-process run: recorder and aggregator share the process,
+        # so the span sink feeds the aggregator directly (no queue hop)
+        from ray_lightning_tpu import telemetry
+        agg = telemetry.TelemetryAggregator(
+            cfg.resolve_dir(trainer.default_root_dir),
+            heartbeat_timeout=cfg.heartbeat_timeout,
+            hard_timeout=cfg.hard_timeout)
+        telemetry.set_active(agg)
+        telemetry.enable(rank=0, sink=lambda recs: agg.ingest_records(
+            0, recs), capacity=cfg.capacity, flush_every=cfg.flush_every)
+        try:
+            return trainer._run_stage(module, datamodule, stage, ckpt_path)
+        finally:
+            telemetry.flush()
+            telemetry.disable()
+            telemetry.set_active(None)
+            trainer._telemetry_paths = agg.export()
 
     def local_devices(self):
         if self._devices is not None:

@@ -85,9 +85,11 @@ def _worker_run(payload: tuple, rank: int, queue) -> Optional[dict]:
     plugin = trainer.plugin
     plugin._is_remote = True
 
+    hb = _setup_worker_telemetry(trainer, rank, queue)
     try:
         result = trainer._run_stage(module, datamodule, stage, ckpt_path)
     finally:
+        _teardown_worker_telemetry(trainer, hb)
         if nproc > 1:
             # Disconnect from the coordination service before the driver
             # kills actors, so teardown is clean (otherwise surviving
@@ -114,6 +116,40 @@ def _worker_run(payload: tuple, rank: int, queue) -> Optional[dict]:
             package["best_model_path"] = ckpt_cb.best_model_path
             package["best_model_score"] = ckpt_cb.best_model_score
     return package
+
+
+def _setup_worker_telemetry(trainer, rank: int, queue):
+    """Enable span recording + heartbeats inside an actor: span batches
+    and beats ride the worker→driver queue to the driver aggregator.
+    Returns the heartbeat sender to stop (None when telemetry is off or
+    the process-level sender from worker_main already beats)."""
+    cfg = getattr(trainer, "telemetry", None)
+    if cfg is None or not cfg.enabled or queue is None:
+        return None
+    from ray_lightning_tpu import telemetry
+    from ray_lightning_tpu.telemetry import heartbeat as hb_mod
+
+    def sink(records, _q=queue, _rank=rank):
+        _q.put((_rank, telemetry.spans_item(_rank, records)))
+
+    telemetry.enable(rank=rank, sink=sink, capacity=cfg.capacity,
+                     flush_every=cfg.flush_every)
+    if hb_mod.process_heartbeat_active():
+        return None  # worker_main (built-in backend) already beats
+    return hb_mod.HeartbeatSender(
+        lambda item, _q=queue, _rank=rank: _q.put((_rank, item)),
+        rank=rank, interval=cfg.heartbeat_interval).start()
+
+
+def _teardown_worker_telemetry(trainer, hb) -> None:
+    cfg = getattr(trainer, "telemetry", None)
+    if cfg is None or not cfg.enabled:
+        return
+    from ray_lightning_tpu import telemetry
+    telemetry.flush()
+    telemetry.disable()
+    if hb is not None:
+        hb.stop()
 
 
 class RayXlaPlugin(ExecutionPlugin):
@@ -165,6 +201,7 @@ class RayXlaPlugin(ExecutionPlugin):
         state["_workers"] = []
         state["_backend"] = None
         state["init_hook"] = None  # already executed before shipping
+        state.pop("_telemetry_agg", None)  # live driver-side aggregator
         return state
 
     def __setstate__(self, state):
@@ -209,6 +246,12 @@ class RayXlaPlugin(ExecutionPlugin):
         backend = get_backend()
         self._backend = backend
         base_env = self._worker_env_base()
+        cfg = trainer.telemetry
+        if cfg.enabled:
+            # workers heartbeat from process start (worker_main) and
+            # record spans once the fit payload arrives (_worker_run)
+            base_env["RLT_TELEMETRY"] = "1"
+            base_env["RLT_HEARTBEAT_INTERVAL"] = str(cfg.heartbeat_interval)
         # unique per fit: reusing names across fits in one driver process
         # lets a late/stale connection from a previous run race the new
         # worker's attach
@@ -216,12 +259,25 @@ class RayXlaPlugin(ExecutionPlugin):
         self._workers = [
             backend.create_actor(
                 RLTExecutor,
-                env=base_env,
+                # rank at spawn time so even pre-setup heartbeats carry
+                # it (set_env_vars re-sends the same value later)
+                env={**base_env, "RLT_PROCESS_ID": str(i)},
                 resources=self._worker_resources(),
                 name=f"rlt-worker-{os.getpid()}-{run_tag}-{i}",
             )
             for i in range(self.num_workers)
         ]
+        agg = None
+        if cfg.enabled:
+            from ray_lightning_tpu import telemetry
+            agg = telemetry.TelemetryAggregator(
+                cfg.resolve_dir(trainer.default_root_dir),
+                heartbeat_timeout=cfg.heartbeat_timeout,
+                hard_timeout=cfg.hard_timeout)
+            for i, w in enumerate(self._workers):
+                agg.register_worker(i, w)
+            telemetry.set_active(agg)
+            self._telemetry_agg = agg
         try:
             return self._execution_loop(trainer, module, datamodule, stage,
                                         ckpt_path, backend)
@@ -229,6 +285,10 @@ class RayXlaPlugin(ExecutionPlugin):
             for w in self._workers:
                 w.kill()  # no_restart parity, ray_ddp.py:383-386
             self._workers = []
+            if agg is not None:
+                from ray_lightning_tpu import telemetry
+                telemetry.set_active(None)
+                trainer._telemetry_paths = agg.export()
 
     def _execution_loop(self, trainer, module, datamodule, stage, ckpt_path,
                         backend):
@@ -264,7 +324,8 @@ class RayXlaPlugin(ExecutionPlugin):
         process_results(env_futs, backend)
 
         queue = None
-        if stage == "fit":
+        if stage == "fit" or trainer.telemetry.enabled:
+            # telemetry needs the worker→driver queue on every stage
             queue = (backend.worker_queue_proxy()
                      if hasattr(backend, "worker_queue_proxy")
                      else WorkerQueueProxy())

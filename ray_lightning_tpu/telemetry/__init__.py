@@ -1,0 +1,113 @@
+"""Distributed run telemetry: per-worker spans, driver aggregation,
+heartbeats and Perfetto trace export.
+
+One coherent observability layer replacing three disconnected ones
+(rank-0-only ThroughputMonitor numbers, the CSVLogger, and external
+profilers): every rank records spans/counters (``spans.py``), batches
+stream to the driver over the existing worker→driver queue channel,
+and the driver merges them into a Chrome/Perfetto ``trace.json`` +
+``telemetry.jsonl`` with per-rank step percentiles and straggler skew
+(``aggregator.py``).  Worker heartbeats (``heartbeat.py``) feed a
+driver watchdog that names a dead or wedged rank instead of hanging
+silently.
+
+Enable with ``Trainer(telemetry=True)`` (or a config dict /
+``TelemetryConfig``), or process-wide with ``RLT_TELEMETRY=1``.
+Artifacts land under ``<default_root_dir>/telemetry/`` — or, inside a
+builtin tune trial, under the trial's own logdir so concurrent trials
+never interleave.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ray_lightning_tpu.telemetry.spans import (  # noqa: F401
+    counter,
+    disable,
+    drain,
+    dropped,
+    enable,
+    enabled,
+    flush,
+    last_span,
+    span,
+)
+from ray_lightning_tpu.telemetry.aggregator import (  # noqa: F401
+    TELEMETRY_KEY,
+    TelemetryAggregator,
+    WorkerHeartbeatTimeout,
+    get_active,
+    set_active,
+    spans_item,
+)
+
+__all__ = [
+    "TelemetryConfig",
+    "TelemetryAggregator",
+    "WorkerHeartbeatTimeout",
+    "TELEMETRY_KEY",
+    "span",
+    "counter",
+    "enable",
+    "disable",
+    "enabled",
+    "flush",
+    "drain",
+    "dropped",
+    "last_span",
+    "get_active",
+    "set_active",
+    "spans_item",
+]
+
+
+@dataclass
+class TelemetryConfig:
+    """Picklable telemetry settings carried on the Trainer (the trainer
+    ships to workers, so the config rides along for free)."""
+
+    enabled: bool = False
+    #: explicit output dir; None = <default_root_dir>/telemetry (or the
+    #: tune trial's logdir when running inside a builtin tune trial)
+    dir: Optional[str] = None
+    heartbeat_interval: float = 5.0
+    heartbeat_timeout: float = 60.0
+    #: raise WorkerHeartbeatTimeout past this silence (None = log only)
+    hard_timeout: Optional[float] = None
+    flush_every: int = 256
+    capacity: int = 65536
+
+    @classmethod
+    def resolve(cls, value: Any) -> "TelemetryConfig":
+        """Trainer's ``telemetry=`` argument → a config.  None defers to
+        the ``RLT_TELEMETRY`` env var; True/False force; a dict supplies
+        field overrides (enabled unless it says otherwise)."""
+        if isinstance(value, cls):
+            return value
+        if value is None:
+            return cls(enabled=os.environ.get("RLT_TELEMETRY", "")
+                       in ("1", "true"))
+        if isinstance(value, bool):
+            return cls(enabled=value)
+        if isinstance(value, dict):
+            cfg = dict(value)
+            cfg.setdefault("enabled", True)
+            return cls(**cfg)
+        raise TypeError(
+            f"telemetry must be None/bool/dict/TelemetryConfig; got "
+            f"{type(value).__name__}")
+
+    def resolve_dir(self, default_root_dir: str) -> str:
+        if self.dir:
+            return self.dir
+        try:
+            from ray_lightning_tpu.tune.session import get_trial_dir
+            trial_dir = get_trial_dir()
+        except Exception:
+            trial_dir = None
+        if trial_dir:
+            return os.path.join(trial_dir, "telemetry")
+        return os.path.join(default_root_dir, "telemetry")

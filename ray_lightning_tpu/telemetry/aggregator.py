@@ -1,0 +1,275 @@
+"""Driver-side telemetry aggregation, watchdog and trace export.
+
+Workers stream batched span/counter records and heartbeats through the
+existing worker→driver queue (``{type: queue}`` frames under the
+built-in backend, ``ray.util.queue`` under Ray — cluster/protocol.py);
+``process_results`` routes every telemetry-marked item here.  The
+aggregator:
+
+- merges all ranks into one timeline and exports a Chrome/Perfetto
+  ``trace.json`` (one Perfetto "process" per rank) plus a
+  ``telemetry.jsonl`` record stream next to the CSVLogger output;
+- computes per-rank step-time percentiles and straggler skew
+  (max/min of per-rank mean step time);
+- runs the heartbeat watchdog: a rank that was beating and stopped for
+  longer than ``heartbeat_timeout`` gets a driver log line naming the
+  rank, its last span and heartbeat age — the "which worker wedged"
+  diagnosis the reference never had (a straggling host was invisible
+  until the whole job stalled, SURVEY.md §5).
+
+The active aggregator is THREAD-local (``set_active``): the builtin
+tune runner executes trials on threads, and each trial's
+``process_results`` loop must feed its own aggregator.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Optional
+
+_log = logging.getLogger(__name__)
+
+#: marker key on queue items that belong to telemetry, not user relays
+TELEMETRY_KEY = "__rlt_telemetry__"
+
+
+def spans_item(rank: int, records: list[dict], host: Optional[str] = None,
+               pid: Optional[int] = None) -> dict:
+    """Wire item carrying a batch of span/counter records."""
+    return {TELEMETRY_KEY: 1, "kind": "spans", "rank": rank,
+            "host": host, "pid": pid or os.getpid(), "records": records}
+
+
+_local = threading.local()
+
+
+def set_active(agg: "Optional[TelemetryAggregator]") -> None:
+    _local.agg = agg
+
+
+def get_active() -> "Optional[TelemetryAggregator]":
+    return getattr(_local, "agg", None)
+
+
+class WorkerHeartbeatTimeout(RuntimeError):
+    """Raised by the watchdog when ``hard_timeout`` is configured and a
+    rank's heartbeats have been silent that long."""
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list (numpy-free:
+    this package must stay importable before heavy deps load)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class TelemetryAggregator:
+    """Merge per-rank telemetry; diagnose dead/wedged workers."""
+
+    def __init__(self, out_dir: str, heartbeat_timeout: float = 60.0,
+                 hard_timeout: Optional[float] = None,
+                 clock=time.monotonic):
+        self.out_dir = out_dir
+        self.heartbeat_timeout = heartbeat_timeout
+        self.hard_timeout = hard_timeout
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._records: list[dict] = []
+        #: pid -> {"at": driver clock, "beat": latest beat dict}; keyed
+        #: by pid because the backend-level sender may beat before the
+        #: worker learns its rank (the beat itself carries the rank)
+        self._hb: dict[int, dict] = {}
+        self._workers: dict[int, Any] = {}   # rank -> ActorHandle
+        self._warned: set[int] = set()
+        self._diagnosed = False
+
+    # -- ingestion -------------------------------------------------------
+
+    def register_worker(self, rank: int, handle: Any = None) -> None:
+        self._workers[rank] = handle
+
+    def maybe_ingest(self, item: Any) -> bool:
+        """Consume a queue payload if it is telemetry; False otherwise
+        (the caller then treats it as a normal relay item)."""
+        if not (isinstance(item, dict) and item.get(TELEMETRY_KEY)):
+            return False
+        kind = item.get("kind")
+        if kind == "spans":
+            self.ingest_records(item.get("rank", -1), item["records"])
+        elif kind == "heartbeat":
+            self._note_heartbeat(item)
+        return True
+
+    def ingest_records(self, rank: int, records: list[dict]) -> None:
+        for r in records:
+            r.setdefault("rank", rank)
+        with self._lock:
+            self._records.extend(records)
+
+    def _note_heartbeat(self, beat: dict) -> None:
+        key = beat.get("pid") or beat.get("rank", -1)
+        with self._lock:
+            self._hb[key] = {"at": self._clock(), "beat": beat}
+            # a recovered worker (e.g. un-wedged) re-arms its warning
+            self._warned.discard(key)
+
+    def heartbeats(self) -> dict:
+        """Latest beat per worker process (tests/diagnostics)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._hb.items()}
+
+    # -- watchdog --------------------------------------------------------
+
+    @staticmethod
+    def _describe(beat: dict, age: float) -> str:
+        rank = beat.get("rank", -1)
+        who = f"rank {rank}" if rank >= 0 else \
+            f"unranked worker (actor {beat.get('actor_id')!r})"
+        return (f"{who}: last heartbeat {age:.1f}s ago, last span "
+                f"{beat.get('last_span')!r}, pid {beat.get('pid')}, "
+                f"host {beat.get('host')}")
+
+    def _alive_note(self, rank: int) -> str:
+        handle = self._workers.get(rank)
+        alive = getattr(handle, "alive", lambda: None)() \
+            if handle is not None else None
+        if alive is None:
+            return ""
+        return ", process alive" if alive else ", process DEAD"
+
+    def watchdog_check(self) -> None:
+        """Called from the driver's poll loop: log a diagnosis line the
+        first time a rank's heartbeats go silent past the timeout (and
+        raise once past ``hard_timeout`` when configured, so a wedged
+        collective cannot hang the driver forever)."""
+        now = self._clock()
+        with self._lock:
+            snapshot = [(k, v["at"], v["beat"]) for k, v in self._hb.items()]
+        for key, at, beat in snapshot:
+            age = now - at
+            if age <= self.heartbeat_timeout:
+                continue
+            if key not in self._warned:
+                self._warned.add(key)
+                _log.warning(
+                    "telemetry watchdog: %s%s — worker is dead or wedged "
+                    "(heartbeat timeout %.1fs)",
+                    self._describe(beat, age),
+                    self._alive_note(beat.get("rank", -1)),
+                    self.heartbeat_timeout)
+            if self.hard_timeout is not None and age > self.hard_timeout:
+                raise WorkerHeartbeatTimeout(
+                    f"telemetry watchdog: {self._describe(beat, age)} "
+                    f"exceeded hard timeout {self.hard_timeout:.1f}s")
+
+    def log_failure_diagnosis(self) -> None:
+        """On a worker failure, log every worker's last-known state once
+        — turns 'a future errored' into 'rank 2 died mid-step'."""
+        if self._diagnosed:
+            return
+        self._diagnosed = True
+        now = self._clock()
+        with self._lock:
+            snapshot = [(v["at"], v["beat"]) for v in self._hb.values()]
+        if not snapshot:
+            return
+        lines = [self._describe(beat, now - at) for at, beat in snapshot]
+        _log.warning("telemetry: worker state at failure:\n  %s",
+                     "\n  ".join(lines))
+
+    # -- analysis --------------------------------------------------------
+
+    def step_stats(self) -> dict:
+        """Per-rank step-time percentiles + straggler skew.  Chunked
+        dispatch (k steps per span) is normalized to per-step time."""
+        per_rank: dict[int, list[float]] = {}
+        with self._lock:
+            records = list(self._records)
+        for r in records:
+            if r.get("t") == "span" and r.get("name") == "step":
+                k = max(1, int((r.get("attrs") or {}).get("k", 1)))
+                per_rank.setdefault(r.get("rank", -1), []).append(
+                    r["dur"] * 1000.0 / k)
+        out: dict[str, Any] = {"per_rank": {}}
+        means = []
+        for rank in sorted(per_rank):
+            ds = sorted(per_rank[rank])
+            mean = sum(ds) / len(ds)
+            means.append(mean)
+            out["per_rank"][str(rank)] = {
+                "steps": len(ds),
+                "mean_ms": round(mean, 3),
+                "p50_ms": round(_percentile(ds, 50), 3),
+                "p90_ms": round(_percentile(ds, 90), 3),
+                "max_ms": round(ds[-1], 3),
+            }
+        if len(means) >= 2 and min(means) > 0:
+            # straggler skew: how much slower the slowest rank's mean
+            # step is than the fastest rank's (1.0 = perfectly even)
+            out["straggler_skew"] = round(max(means) / min(means), 3)
+        return out
+
+    # -- export ----------------------------------------------------------
+
+    def _trace_events(self, records: list[dict]) -> list[dict]:
+        spans = [r for r in records if r.get("t") in ("span", "counter")]
+        if not spans:
+            return []
+        t0 = min(r["ts"] for r in spans)
+        events: list[dict] = []
+        for rank in sorted({r.get("rank", -1) for r in spans}):
+            events.append({"ph": "M", "name": "process_name", "pid": rank,
+                           "args": {"name": f"rank {rank}"}})
+        for r in spans:
+            base = {"pid": r.get("rank", -1), "tid": 0,
+                    "ts": round((r["ts"] - t0) * 1e6, 1)}
+            if r["t"] == "span":
+                events.append({**base, "ph": "X", "cat": "rlt",
+                               "name": r["name"],
+                               "dur": round(r["dur"] * 1e6, 1),
+                               "args": r.get("attrs") or {}})
+            else:
+                events.append({**base, "ph": "C", "name": r["name"],
+                               "args": {r["name"]: r["value"]}})
+        return events
+
+    def export(self) -> dict:
+        """Write ``trace.json`` (Chrome/Perfetto) and ``telemetry.jsonl``
+        under ``out_dir``; returns their paths plus the summary dict."""
+        os.makedirs(self.out_dir, exist_ok=True)
+        trace_path = os.path.join(self.out_dir, "trace.json")
+        jsonl_path = os.path.join(self.out_dir, "telemetry.jsonl")
+        with self._lock:
+            records = list(self._records)
+        stats = self.step_stats()
+        summary = {
+            "t": "summary",
+            "records": len(records),
+            "ranks": sorted({r.get("rank", -1) for r in records}),
+            "step_stats": stats,
+        }
+        tmp = trace_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"traceEvents": self._trace_events(records),
+                       "displayTimeUnit": "ms"}, f)
+        os.replace(tmp, trace_path)
+        tmp = jsonl_path + ".tmp"
+        with open(tmp, "w") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+            f.write(json.dumps(summary) + "\n")
+        os.replace(tmp, jsonl_path)
+        skew = stats.get("straggler_skew")
+        _log.info(
+            "telemetry: %d records from ranks %s -> %s%s", len(records),
+            summary["ranks"], trace_path,
+            f" (straggler skew {skew})" if skew else "")
+        return {"trace": trace_path, "jsonl": jsonl_path,
+                "summary": summary}

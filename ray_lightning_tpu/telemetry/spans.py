@@ -1,0 +1,215 @@
+"""Span/counter recording — the worker-side half of run telemetry.
+
+The reference's only perf surface is a single epoch-timer callback
+(SURVEY.md §5); this module gives every process a lightweight
+monotonic-clock span API the hot loop can afford:
+
+- ``span("step")`` / ``span("compile")`` / ``span("collective")`` /
+  ``span("data_wait")`` — context managers timing host-side phases.
+  Nesting is tracked (``depth``), so a ``collective`` inside a
+  ``checkpoint`` renders nested in the Perfetto timeline.
+- ``counter(name, value)`` — point-in-time scalars (throughput, HBM).
+
+Disabled is the default and costs one attribute load + one function
+call per ``span()``: the module returns a no-op singleton, allocates
+nothing, and records nothing — instrumentation stays in the hot loop
+unconditionally.  ``enable()`` installs a process-wide recorder with a
+bounded ring buffer; full buffers drop the OLDEST records (a counter
+reports how many) so telemetry can never grow without bound or stall
+training.  Batches flush to a ``sink`` callable (the worker→driver
+queue under distributed plugins, the aggregator directly in-process);
+flushing never raises into the training loop.
+
+No jax/numpy imports here: worker_main starts heartbeats through this
+package before any heavy import happens.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Optional
+
+_log = logging.getLogger(__name__)
+
+
+class _NoopSpan:
+    """Singleton returned by ``span()`` when recording is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "t0")
+
+    def __init__(self, name: str, attrs: Optional[dict]):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        rec = _recorder
+        if rec is not None:
+            rec.stack.append(self.name)
+            rec.last_span = self.name
+        self.t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.monotonic()
+        rec = _recorder
+        if rec is None:  # disabled mid-span: drop silently
+            return False
+        if rec.stack and rec.stack[-1] == self.name:
+            rec.stack.pop()
+        record = {
+            "t": "span",
+            "name": self.name,
+            "ts": self.t0 + rec.offset,
+            "dur": t1 - self.t0,
+            "rank": rec.rank,
+            "depth": len(rec.stack),
+        }
+        if self.attrs:
+            record["attrs"] = self.attrs
+        rec.add(record)
+        return False
+
+
+class _Recorder:
+    """Process-wide ring buffer + sink.  The lock covers buffer swaps
+    only; the training loop's common case is one append under it."""
+
+    def __init__(self, rank: int, sink: Optional[Callable],
+                 capacity: int, flush_every: Optional[int]):
+        self.rank = rank
+        self.sink = sink
+        self.capacity = max(1, int(capacity))
+        self.flush_every = flush_every
+        # monotonic→wall offset, captured once: records carry wall-clock
+        # timestamps so the driver can merge ranks onto one timeline
+        # (same-host skew is zero; cross-host skew is NTP-bounded)
+        self.offset = time.time() - time.monotonic()
+        self.records: list[dict] = []
+        self.dropped = 0
+        self.lock = threading.Lock()
+        self.stack: list[str] = []       # open span names (host loop)
+        self.last_span: Optional[str] = None
+        self._sink_failed = False
+
+    def add(self, record: dict) -> None:
+        batch = None
+        with self.lock:
+            if len(self.records) >= self.capacity:
+                self.records.pop(0)
+                self.dropped += 1
+            self.records.append(record)
+            if self.sink is not None and self.flush_every \
+                    and len(self.records) >= self.flush_every:
+                batch, self.records = self.records, []
+        if batch:
+            self._emit(batch)
+
+    def flush(self) -> None:
+        with self.lock:
+            batch, self.records = self.records, []
+        if batch and self.sink is not None:
+            self._emit(batch)
+        elif batch:
+            # no sink: flushing without a consumer would lose records —
+            # put them back for drain()
+            with self.lock:
+                self.records = batch + self.records
+
+    def drain(self) -> list[dict]:
+        with self.lock:
+            batch, self.records = self.records, []
+        return batch
+
+    def _emit(self, batch: list[dict]) -> None:
+        try:
+            self.sink(batch)
+        except Exception:
+            # telemetry must never kill training; warn once per recorder
+            if not self._sink_failed:
+                self._sink_failed = True
+                _log.warning("telemetry sink failed; further records "
+                             "will be dropped silently", exc_info=True)
+
+
+_recorder: Optional[_Recorder] = None
+
+
+def enable(rank: int = 0, sink: Optional[Callable] = None,
+           capacity: int = 65536, flush_every: Optional[int] = 256) -> None:
+    """Install a process-wide recorder.  ``sink(batch_of_records)`` is
+    called with full batches (and on ``flush()``); with no sink the
+    records accumulate in the ring buffer for ``drain()``."""
+    global _recorder
+    _recorder = _Recorder(rank, sink, capacity, flush_every)
+
+
+def disable() -> None:
+    global _recorder
+    _recorder = None
+
+
+def enabled() -> bool:
+    return _recorder is not None
+
+
+def span(name: str, **attrs: Any):
+    """Time a host-side phase.  No-op singleton when disabled."""
+    if _recorder is None:
+        return _NOOP
+    return _Span(name, attrs or None)
+
+
+def counter(name: str, value: float, **attrs: Any) -> None:
+    """Record a point-in-time scalar (no-op when disabled)."""
+    rec = _recorder
+    if rec is None:
+        return
+    record = {
+        "t": "counter",
+        "name": name,
+        "ts": time.monotonic() + rec.offset,
+        "value": float(value),
+        "rank": rec.rank,
+    }
+    if attrs:
+        record["attrs"] = attrs
+    rec.add(record)
+
+
+def flush() -> None:
+    rec = _recorder
+    if rec is not None:
+        rec.flush()
+
+
+def drain() -> list[dict]:
+    """Return and clear buffered records (sink-less recorders)."""
+    rec = _recorder
+    return rec.drain() if rec is not None else []
+
+
+def dropped() -> int:
+    rec = _recorder
+    return rec.dropped if rec is not None else 0
+
+
+def last_span() -> Optional[str]:
+    """Most recently ENTERED span name — heartbeats carry this so the
+    driver watchdog can say what a dead worker was doing."""
+    rec = _recorder
+    return rec.last_span if rec is not None else None

@@ -51,6 +51,11 @@ class Trial:
         self.history: list[dict] = []
         self.latest_checkpoint: Optional[str] = None
         self.error: Optional[str] = None
+        #: where a telemetry-enabled Trainer inside this trial writes
+        #: its trace.json/telemetry.jsonl: TelemetryConfig.resolve_dir
+        #: resolves against the live trial session (tune/session.py), so
+        #: concurrent trials never interleave into one shared dir
+        self.telemetry_dir = os.path.join(logdir, "telemetry")
         #: device lease this trial ran on (in-process trials only;
         #: populated at first acquire — tune/session.py) for post-hoc
         #: "which chips ran this trial" debugging via ExperimentAnalysis
@@ -223,6 +228,11 @@ def run(
     story is exactly "Tune trial retries + checkpoints", SURVEY.md §5);
     a trainable with a ``checkpoint_dir`` parameter resumes from the
     trial's latest checkpoint.
+
+    Telemetry: a trial whose Trainer enables telemetry writes its
+    trace/jsonl under the trial's own logdir (``Trial.telemetry_dir``)
+    — the thread-local trial session scopes both the output dir and
+    the active driver-side aggregator per trial.
 
     Device isolation: when ``resources_per_trial`` declares a TPU chip
     count (``get_tune_resources(...)`` bundles or ``{"TPU": n}``), the

@@ -3,7 +3,11 @@
 ``process_results`` is the driver's poll loop: wait on worker futures
 while draining the worker→driver queue and executing relayed callables
 (Tune reports/checkpoints) in the driver process — the "relay the
-side-effect, not the call" pattern (SURVEY.md §3.3).
+side-effect, not the call" pattern (SURVEY.md §3.3).  Telemetry items
+(span batches, heartbeats — telemetry/) ride the same queue and are
+routed to the active aggregator instead of executed; each poll
+iteration also runs the heartbeat watchdog, so a dead or wedged worker
+gets a named driver log line instead of a silent hang.
 """
 
 from __future__ import annotations
@@ -12,6 +16,7 @@ import time
 from typing import Any, Sequence
 
 from ray_lightning_tpu.cluster.backend import ClusterBackend, Future
+from ray_lightning_tpu.telemetry.aggregator import get_active
 from ray_lightning_tpu.utils.states import load_state_stream, to_state_stream
 
 __all__ = ["process_results", "to_state_stream", "load_state_stream"]
@@ -19,12 +24,16 @@ __all__ = ["process_results", "to_state_stream", "load_state_stream"]
 
 def _handle_queue_item(item: Any) -> None:
     """Execute one queue item on the driver.  Items are ``(rank, payload)``
-    tuples; callable payloads are invoked here so driver-context APIs
-    (e.g. the tune session) work (util.py:47-52 analog)."""
+    tuples; telemetry-marked payloads feed the active aggregator;
+    callable payloads are invoked here so driver-context APIs (e.g. the
+    tune session) work (util.py:47-52 analog)."""
     if isinstance(item, tuple) and len(item) == 2:
         _rank, payload = item
     else:
         payload = item
+    agg = get_active()
+    if agg is not None and agg.maybe_ingest(payload):
+        return
     if callable(payload):
         payload()
 
@@ -33,7 +42,8 @@ def process_results(futures: Sequence[Future], backend: ClusterBackend,
                     poll_interval: float = 0.02) -> list:
     """Busy-poll worker futures, relaying queue items as they arrive
     (util.py:55-68 analog).  A worker error raises immediately, failing
-    the whole run (parity with ray.get semantics, util.py:61-63)."""
+    the whole run (parity with ray.get semantics, util.py:61-63) — with
+    a per-rank telemetry diagnosis logged first when available."""
     pending = list(futures)
     while not all(f.done() for f in pending):
         drained = False
@@ -43,9 +53,17 @@ def process_results(futures: Sequence[Future], backend: ClusterBackend,
                 break
             drained = True
             _handle_queue_item(item)
+        agg = get_active()
+        if agg is not None:
+            agg.watchdog_check()
         for f in pending:
             if f.done():
-                f.result()  # raise worker errors eagerly
+                try:
+                    f.result()  # raise worker errors eagerly
+                except BaseException:
+                    if agg is not None:
+                        agg.log_failure_diagnosis()
+                    raise
         if not drained:
             time.sleep(poll_interval)
     # final drain: items enqueued just before workers finished

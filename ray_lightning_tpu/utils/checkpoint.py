@@ -28,6 +28,8 @@ from typing import Any, Optional
 
 import jax
 
+from ray_lightning_tpu.telemetry import span
+
 
 def _manager(directory: str, async_save: bool, max_to_keep: Optional[int]):
     import orbax.checkpoint as ocp
@@ -73,13 +75,17 @@ class ShardedCheckpointer:
         import orbax.checkpoint as ocp
         if int(step) in self._mgr.all_steps():
             return
-        self._mgr.save(int(step), args=ocp.args.Composite(
-            state=ocp.args.StandardSave(state),
-            meta=ocp.args.JsonSave(dict(meta or {}))))
+        # the span covers only the blocking part of an async save (the
+        # device→host copy); the disk write proceeds behind training
+        with span("checkpoint", step=int(step), sharded=True):
+            self._mgr.save(int(step), args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                meta=ocp.args.JsonSave(dict(meta or {}))))
 
     def wait(self) -> None:
         """Block until in-flight async saves hit disk."""
-        self._mgr.wait_until_finished()
+        with span("checkpoint_wait"):
+            self._mgr.wait_until_finished()
 
     # -- restore ---------------------------------------------------------
 

@@ -127,15 +127,30 @@ class CSVLogger:
         self._started = True
 
     def _rewrite_with_new_header(self) -> None:
+        """Fold the existing file into the grown header.  Crash-safe:
+        the re-headered copy is written to a temp file in the same
+        directory and ``os.replace``d over the original, so a crash
+        mid-rewrite leaves the old complete file, never a truncated
+        ``metrics.csv``."""
         if not self._started or not os.path.exists(self.path):
             return
         with open(self.path, newline="") as f:
             old_rows = list(csv.DictReader(f))
-        with open(self.path, "w", newline="") as f:
-            writer = csv.DictWriter(f, fieldnames=self._fields, restval="")
-            writer.writeheader()
-            for r in old_rows:
-                writer.writerow(r)
+        fd, tmp = tempfile.mkstemp(dir=self.log_dir, suffix=".csv")
+        try:
+            with os.fdopen(fd, "w", newline="") as f:
+                writer = csv.DictWriter(f, fieldnames=self._fields,
+                                        restval="")
+                writer.writeheader()
+                for r in old_rows:
+                    writer.writerow(r)
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     def finalize(self) -> None:
         """Everything is flushed on write; nothing buffered."""

@@ -30,6 +30,7 @@ from typing import Optional
 import numpy as np
 
 from ray_lightning_tpu.core.callbacks import Callback
+from ray_lightning_tpu import telemetry
 
 _log = logging.getLogger(__name__)
 
@@ -56,6 +57,14 @@ class ThroughputMonitor(Callback):
     slow training, so the monitor forces one sync every ``window`` steps
     and averages over the window.
     """
+
+    @staticmethod
+    def _emit(trainer, name: str, value: float) -> None:
+        """One emission path: ``callback_metrics`` (rank-0's copy rides
+        the distributed result relay) AND a telemetry counter (every
+        rank's value lands on the merged driver timeline)."""
+        trainer.log_metric(name, value)
+        telemetry.counter(name, value)
 
     def __init__(self, window: int = 50, log_tokens: bool = True):
         self.window = max(1, int(window))
@@ -119,10 +128,15 @@ class ThroughputMonitor(Callback):
         now = time.monotonic()
         if self._t0 is not None:
             dt = now - self._t0
-            trainer.log_metric("steps_per_sec", self._steps / dt)
-            trainer.log_metric("samples_per_sec", self._samples / dt)
+            self._emit(trainer, "steps_per_sec", self._steps / dt)
+            self._emit(trainer, "samples_per_sec", self._samples / dt)
             if self.log_tokens and self._units != self._samples:
-                trainer.log_metric("tokens_per_sec", self._units / dt)
+                self._emit(trainer, "tokens_per_sec", self._units / dt)
+            # peak HBM per window (not just per epoch): regressions show
+            # up at window granularity on the telemetry timeline
+            peak = peak_device_memory_bytes()
+            if peak:
+                self._emit(trainer, "peak_memory_mb", peak / 1e6)
         self._t0 = now
         self._units = 0
         self._samples = 0
@@ -130,11 +144,11 @@ class ThroughputMonitor(Callback):
 
     def on_train_epoch_end(self, trainer, module):
         if self._epoch_t0 is not None:
-            trainer.log_metric("epoch_time_s",
-                               time.monotonic() - self._epoch_t0)
+            self._emit(trainer, "epoch_time_s",
+                       time.monotonic() - self._epoch_t0)
         peak = peak_device_memory_bytes()
         if peak:
-            trainer.log_metric("peak_memory_mb", peak / 1e6)
+            self._emit(trainer, "peak_memory_mb", peak / 1e6)
         # new window per epoch: the epoch boundary does host work
         self._reset_window(trainer)
 

@@ -9,8 +9,11 @@ this file covers the harsher kill-without-cleanup mode and driver
 reusability afterwards.
 """
 
+import logging
 import os
+import signal
 import threading
+import time
 
 import pytest
 
@@ -57,6 +60,73 @@ def test_worker_hard_crash_raises_not_hangs():
             os._exit(17)
 
     _fit_must_raise_within(_trainer(DieInWorker()), BoringModel(), 240)
+
+
+@pytest.mark.slow
+def test_heartbeat_watchdog_names_wedged_rank(caplog):
+    """A worker that stops making progress WITHOUT dying (SIGSTOP — the
+    connection stays open, so no future errors) must be named by the
+    driver's heartbeat watchdog within the timeout, instead of the fit
+    hanging with zero explanation.  The process is then killed so the
+    fit fails over the normal dead-worker path."""
+    trainer = Trainer(
+        max_epochs=1, limit_train_batches=64, limit_val_batches=0,
+        num_sanity_val_steps=0, enable_checkpointing=False,
+        callbacks=[], plugins=[cpu_plugin(2)], seed=0,
+        log_every_n_steps=1,
+        telemetry={"heartbeat_interval": 0.2, "heartbeat_timeout": 2.0})
+    box = {}
+
+    def run():
+        try:
+            trainer.fit(BoringModel(dataset_length=256))
+            box["outcome"] = "returned"
+        except Exception as e:   # noqa: BLE001
+            box["outcome"] = "raised"
+            box["error"] = e
+
+    def beats_by_rank():
+        agg = getattr(trainer.plugin, "_telemetry_agg", None)
+        if agg is None:
+            return {}
+        return {v["beat"].get("rank"): v["beat"]
+                for v in agg.heartbeats().values()}
+
+    victim_pid = None
+    with caplog.at_level(
+            logging.WARNING,
+            logger="ray_lightning_tpu.telemetry.aggregator"):
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 120
+        # wait for rank 1's heartbeats to reach the driver aggregator
+        while time.monotonic() < deadline:
+            beat = beats_by_rank().get(1)
+            if beat is not None:
+                victim_pid = beat["pid"]
+                break
+            time.sleep(0.05)
+        assert victim_pid is not None, "rank 1 never heartbeat"
+        os.kill(victim_pid, signal.SIGSTOP)
+        try:
+            # the watchdog must name the rank within the timeout window
+            # (generous wall bound for CI; the configured timeout is 2s)
+            found = None
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and found is None:
+                for rec in caplog.records:
+                    if "rank 1" in rec.message \
+                            and "dead or wedged" in rec.message:
+                        found = rec.message
+                        break
+                time.sleep(0.05)
+        finally:
+            os.kill(victim_pid, signal.SIGKILL)
+        assert found, "watchdog never named the wedged rank"
+        assert "last heartbeat" in found and "last span" in found
+        t.join(240)
+        assert not t.is_alive(), "fit hung after the worker was killed"
+        assert box.get("outcome") == "raised"
 
 
 def test_driver_usable_after_worker_failure():

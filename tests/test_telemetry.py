@@ -1,0 +1,303 @@
+"""Run telemetry: span/counter recording, driver-side aggregation,
+heartbeat watchdog, and Perfetto trace export (telemetry/).
+
+The e2e case mirrors the subsystem's reason to exist (SURVEY.md §5: the
+reference observes nothing but an epoch timer, and only on rank 0): a
+2-worker local-backend fit must land step/compile/collective spans from
+BOTH ranks on one driver timeline.
+"""
+
+import json
+import logging
+import os
+import time
+
+import pytest
+
+from ray_lightning_tpu import Trainer, telemetry
+from ray_lightning_tpu.models import BoringModel
+from ray_lightning_tpu.telemetry.aggregator import (
+    TelemetryAggregator,
+    WorkerHeartbeatTimeout,
+)
+from ray_lightning_tpu.telemetry.heartbeat import make_heartbeat
+
+from tests.utils import cpu_plugin
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Recorder and active aggregator are process/thread-ambient; never
+    leak them across tests."""
+    yield
+    telemetry.disable()
+    telemetry.set_active(None)
+
+
+# -- span/counter API ----------------------------------------------------
+
+def test_span_nesting_depth_and_rank():
+    telemetry.enable(rank=3, sink=None, flush_every=None)
+    with telemetry.span("outer"):
+        with telemetry.span("inner", step=7):
+            pass
+    recs = telemetry.drain()
+    by_name = {r["name"]: r for r in recs}
+    assert set(by_name) == {"outer", "inner"}
+    assert by_name["inner"]["depth"] == 1
+    assert by_name["outer"]["depth"] == 0
+    assert by_name["inner"]["attrs"] == {"step": 7}
+    assert all(r["rank"] == 3 for r in recs)
+    assert all(r["dur"] >= 0 for r in recs)
+    # inner is fully contained in outer on the timeline
+    assert by_name["inner"]["ts"] >= by_name["outer"]["ts"]
+
+
+def test_disabled_mode_is_noop_singleton():
+    assert not telemetry.enabled()
+    # identity: no allocation per call when disabled
+    assert telemetry.span("a") is telemetry.span("b")
+    telemetry.counter("x", 1.0)      # must not raise
+    assert telemetry.drain() == []
+    # overhead: purely a bound sanity check (generous: ~20µs/span)
+    t0 = time.monotonic()
+    for _ in range(10_000):
+        with telemetry.span("step"):
+            pass
+    assert time.monotonic() - t0 < 0.2
+
+
+def test_counter_and_last_span():
+    telemetry.enable(rank=0, sink=None, flush_every=None)
+    assert telemetry.last_span() is None
+    with telemetry.span("compile"):
+        assert telemetry.last_span() == "compile"
+        telemetry.counter("hbm_mb", 12.5)
+    recs = telemetry.drain()
+    counters = [r for r in recs if r["t"] == "counter"]
+    (c,) = counters
+    assert c["name"] == "hbm_mb" and c["value"] == 12.5
+
+
+def test_sink_batching_and_flush():
+    batches = []
+    telemetry.enable(rank=1, sink=batches.append, flush_every=2)
+    with telemetry.span("a"):
+        pass
+    assert batches == []           # below the batch threshold
+    with telemetry.span("b"):
+        pass
+    assert len(batches) == 1 and len(batches[0]) == 2
+    with telemetry.span("c"):
+        pass
+    telemetry.flush()
+    assert len(batches) == 2 and batches[1][0]["name"] == "c"
+
+
+def test_ring_buffer_drops_oldest_never_grows():
+    telemetry.enable(rank=0, sink=None, capacity=3, flush_every=None)
+    for i in range(10):
+        telemetry.counter("c", i)
+    assert telemetry.dropped() == 7
+    recs = telemetry.drain()
+    assert [r["value"] for r in recs] == [7.0, 8.0, 9.0]
+
+
+def test_failing_sink_never_raises_into_training():
+    def bad_sink(batch):
+        raise RuntimeError("sink down")
+
+    telemetry.enable(rank=0, sink=bad_sink, flush_every=1)
+    with telemetry.span("step"):   # must not raise
+        pass
+    telemetry.flush()
+
+
+# -- aggregator ----------------------------------------------------------
+
+def _span_rec(rank, name, ts, dur, **attrs):
+    r = {"t": "span", "name": name, "ts": ts, "dur": dur, "rank": rank,
+         "depth": 0}
+    if attrs:
+        r["attrs"] = attrs
+    return r
+
+
+def test_aggregator_merges_ranks_and_exports(tmp_path):
+    agg = TelemetryAggregator(str(tmp_path / "telemetry"))
+    # rank 1 is a 2x straggler
+    for i in range(10):
+        agg.maybe_ingest(telemetry.spans_item(
+            0, [_span_rec(0, "step", 100.0 + i, 0.010)]))
+        agg.maybe_ingest(telemetry.spans_item(
+            1, [_span_rec(1, "step", 100.0 + i, 0.020)]))
+    agg.ingest_records(0, [_span_rec(0, "compile", 99.0, 1.0)])
+    stats = agg.step_stats()
+    assert stats["per_rank"]["0"]["steps"] == 10
+    assert stats["per_rank"]["1"]["mean_ms"] == pytest.approx(20.0)
+    assert stats["straggler_skew"] == pytest.approx(2.0)
+
+    paths = agg.export()
+    with open(paths["trace"]) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    span_events = [e for e in events if e.get("ph") == "X"]
+    assert {e["pid"] for e in span_events} == {0, 1}
+    assert {"step", "compile"} <= {e["name"] for e in span_events}
+    with open(paths["jsonl"]) as f:
+        lines = [json.loads(line) for line in f]
+    assert lines[-1]["t"] == "summary"
+    assert lines[-1]["step_stats"]["straggler_skew"] == pytest.approx(2.0)
+    assert {r.get("rank") for r in lines[:-1]} == {0, 1}
+
+
+def test_aggregator_normalizes_chunked_steps(tmp_path):
+    agg = TelemetryAggregator(str(tmp_path))
+    # one span covering k=4 steps in 40ms -> 10ms/step
+    agg.ingest_records(0, [_span_rec(0, "step", 10.0, 0.040, k=4)])
+    assert agg.step_stats()["per_rank"]["0"]["mean_ms"] == \
+        pytest.approx(10.0)
+
+
+def test_non_telemetry_items_pass_through(tmp_path):
+    agg = TelemetryAggregator(str(tmp_path))
+    assert not agg.maybe_ingest({"some": "dict"})
+    assert not agg.maybe_ingest((0, lambda: None))
+    assert not agg.maybe_ingest("string")
+
+
+def test_watchdog_names_silent_rank(tmp_path, caplog):
+    clock = [0.0]
+    agg = TelemetryAggregator(str(tmp_path), heartbeat_timeout=5.0,
+                              clock=lambda: clock[0])
+    agg.maybe_ingest(make_heartbeat(0))
+    beat1 = make_heartbeat(1)
+    beat1["pid"] = beat1["pid"] + 1   # distinct worker process
+    beat1["last_span"] = "step"
+    agg.maybe_ingest(beat1)
+    clock[0] = 3.0
+    agg.maybe_ingest(make_heartbeat(0))   # rank 0 keeps beating
+    clock[0] = 7.0
+    agg.maybe_ingest(make_heartbeat(0))
+    with caplog.at_level(logging.WARNING,
+                         logger="ray_lightning_tpu.telemetry.aggregator"):
+        agg.watchdog_check()
+    msgs = [r.message for r in caplog.records]
+    assert any("rank 1" in m and "last span 'step'" in m for m in msgs)
+    assert not any("rank 0:" in m for m in msgs)
+    # warned once, not per poll iteration
+    caplog.clear()
+    with caplog.at_level(logging.WARNING,
+                         logger="ray_lightning_tpu.telemetry.aggregator"):
+        agg.watchdog_check()
+    assert not caplog.records
+
+
+def test_watchdog_hard_timeout_raises(tmp_path):
+    clock = [0.0]
+    agg = TelemetryAggregator(str(tmp_path), heartbeat_timeout=1.0,
+                              hard_timeout=5.0, clock=lambda: clock[0])
+    agg.maybe_ingest(make_heartbeat(2))
+    clock[0] = 6.0
+    with pytest.raises(WorkerHeartbeatTimeout, match="rank 2"):
+        agg.watchdog_check()
+
+
+# -- trainer integration -------------------------------------------------
+
+def test_local_fit_exports_trace(tmp_path, seed):
+    trainer = Trainer(max_epochs=1, limit_train_batches=4,
+                      limit_val_batches=2, num_sanity_val_steps=0,
+                      enable_checkpointing=True, seed=0,
+                      log_every_n_steps=1, default_root_dir=str(tmp_path),
+                      telemetry=True)
+    trainer.fit(BoringModel())
+    paths = trainer._telemetry_paths
+    assert paths is not None
+    with open(paths["trace"]) as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "X"}
+    assert {"step", "compile", "init", "data_wait", "eval",
+            "checkpoint"} <= names
+    assert paths["summary"]["step_stats"]["per_rank"]["0"]["steps"] == 4
+    # recorder must be torn down after the run
+    assert not telemetry.enabled()
+    assert telemetry.get_active() is None
+
+
+def test_telemetry_disabled_records_nothing(tmp_path, seed):
+    trainer = Trainer(max_epochs=1, limit_train_batches=2,
+                      limit_val_batches=0, num_sanity_val_steps=0,
+                      enable_checkpointing=False, seed=0,
+                      default_root_dir=str(tmp_path))
+    trainer.fit(BoringModel())
+    assert trainer._telemetry_paths is None
+    assert not os.path.exists(os.path.join(str(tmp_path), "telemetry"))
+
+
+def test_config_resolution():
+    from ray_lightning_tpu.telemetry import TelemetryConfig
+    assert not TelemetryConfig.resolve(None).enabled
+    assert TelemetryConfig.resolve(True).enabled
+    cfg = TelemetryConfig.resolve({"heartbeat_timeout": 7.5})
+    assert cfg.enabled and cfg.heartbeat_timeout == 7.5
+    assert TelemetryConfig.resolve(cfg) is cfg
+    with pytest.raises(TypeError):
+        TelemetryConfig.resolve(3)
+    assert cfg.resolve_dir("/root/x") == "/root/x/telemetry"
+
+
+def test_per_trial_dir_resolution(tmp_path):
+    """Inside a builtin tune trial, telemetry lands in the trial's own
+    logdir (tune/runner.py Trial.telemetry_dir contract)."""
+    from ray_lightning_tpu.telemetry import TelemetryConfig
+    from ray_lightning_tpu.tune.runner import Trial
+    from ray_lightning_tpu.tune.session import TrialSession, set_session
+    trial = Trial("trial_00000", {}, str(tmp_path / "trial_00000"))
+    set_session(TrialSession(trial, lambda *a: None))
+    try:
+        cfg = TelemetryConfig.resolve(True)
+        assert cfg.resolve_dir("/elsewhere") == trial.telemetry_dir
+    finally:
+        set_session(None)
+
+
+# -- end-to-end over the cluster backend --------------------------------
+
+@pytest.mark.slow
+def test_e2e_two_workers_spans_from_both_ranks(tmp_path, seed):
+    """2-worker local-backend fit: the driver aggregator must see
+    step/compile/collective spans from BOTH ranks and export a
+    Perfetto-loadable trace.json."""
+    trainer = Trainer(max_epochs=1, limit_train_batches=4,
+                      limit_val_batches=0, num_sanity_val_steps=0,
+                      enable_checkpointing=False, seed=0,
+                      log_every_n_steps=1, plugins=[cpu_plugin(2)],
+                      default_root_dir=str(tmp_path),
+                      telemetry={"heartbeat_interval": 0.5})
+    trainer.fit(BoringModel())
+
+    paths = trainer._telemetry_paths
+    assert paths is not None
+    with open(paths["trace"]) as f:
+        trace = json.load(f)          # valid JSON by construction
+    span_events = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    by_rank = {}
+    for e in span_events:
+        by_rank.setdefault(e["pid"], set()).add(e["name"])
+    assert set(by_rank) == {0, 1}
+    for rank, names in by_rank.items():
+        assert {"step", "compile", "collective"} <= names, \
+            f"rank {rank} missing spans: {names}"
+
+    with open(paths["jsonl"]) as f:
+        lines = [json.loads(line) for line in f]
+    summary = lines[-1]
+    assert summary["t"] == "summary"
+    per_rank = summary["step_stats"]["per_rank"]
+    assert set(per_rank) == {"0", "1"}
+    assert per_rank["0"]["steps"] == 4 and per_rank["1"]["steps"] == 4
+    # both workers heartbeat over the queue channel
+    hb = trainer.plugin._telemetry_agg.heartbeats()
+    assert {v["beat"]["rank"] for v in hb.values()} == {0, 1}
