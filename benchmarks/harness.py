@@ -123,6 +123,13 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
     paths = getattr(trainer, "_telemetry_paths", None)
     if paths:
         result["telemetry_jsonl"] = paths["jsonl"]
+        # memory + comms alongside steps/sec, so BENCH rounds catch HBM
+        # and collective-traffic regressions that leave wall time alone
+        summary = paths.get("summary") or {}
+        if "hbm_peak_bytes" in summary:
+            result["hbm_peak_bytes"] = summary["hbm_peak_bytes"]
+        if "collective_gibs" in summary:
+            result["collective_gibs"] = summary["collective_gibs"]
     if inline_device_ms and timer.trace_dir is not None:
         from benchmarks import trace_tools
         med = trace_tools.dominant_module_ms_or_none(timer.trace_dir)

@@ -26,8 +26,17 @@ for arg in "$@"; do
         *) echo "usage: $0 [--check] [--all]" >&2; exit 2 ;;
     esac
 done
-# --check is non-mutating by construction: only flake8 runs below.
-: "$CHECK"
+# --check is non-mutating by construction: only checks run below.
+if [[ "$CHECK" == 1 ]]; then
+    # metrics-name lint: every instrument registered anywhere in the
+    # package must be Prometheus-clean — rlt_ prefix + a unit suffix
+    # (_bytes/_seconds/_total) — so the driver's /metrics exposition
+    # never emits an unscrapable series (telemetry/metrics.py).
+    # (-c entry, not -m: the telemetry package imports the module at
+    # init, and runpy would re-execute it with a RuntimeWarning)
+    python -c 'import sys; from ray_lightning_tpu.telemetry.metrics \
+        import _main; sys.exit(_main(["--check-names"]))'
+fi
 
 if [[ "$ALL" == 1 ]]; then
     exec flake8 "${FLAKE8_ARGS[@]}" ray_lightning_tpu tests benchmarks bench.py __graft_entry__.py

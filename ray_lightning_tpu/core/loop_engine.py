@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
@@ -44,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_lightning_tpu.telemetry import span
+from ray_lightning_tpu.telemetry import metrics as _metrics
 
 _log = logging.getLogger(__name__)
 
@@ -121,21 +123,25 @@ class StreamSource:
         batch — when it rivals the step span, the loader is the
         bottleneck."""
         t = self._trainer
-        with span("data_wait"):
-            while not self.exhausted:
-                try:
-                    batch_idx, batch = next(self._it)
-                except StopIteration:
-                    self.exhausted = True
-                    return None
-                if t.limit_train_batches is not None \
-                        and batch_idx >= t.limit_train_batches:
-                    self.exhausted = True
-                    return None
-                if t._batch_ok(batch, self._strategy):
-                    return Item(batch_idx=batch_idx, kind="host",
-                                payload=batch)
-        return None
+        t0 = time.monotonic()
+        try:
+            with span("data_wait"):
+                while not self.exhausted:
+                    try:
+                        batch_idx, batch = next(self._it)
+                    except StopIteration:
+                        self.exhausted = True
+                        return None
+                    if t.limit_train_batches is not None \
+                            and batch_idx >= t.limit_train_batches:
+                        self.exhausted = True
+                        return None
+                    if t._batch_ok(batch, self._strategy):
+                        return Item(batch_idx=batch_idx, kind="host",
+                                    payload=batch)
+            return None
+        finally:
+            _metrics.on_data_wait(time.monotonic() - t0)
 
     def _start_transfer(self, item: Item) -> None:
         if item.device is not None:

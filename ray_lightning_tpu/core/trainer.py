@@ -50,6 +50,7 @@ from ray_lightning_tpu.parallel.gather import fetch_tree
 from ray_lightning_tpu.parallel.mesh import set_current_mesh
 from ray_lightning_tpu.parallel.strategy import resolve_strategy
 from ray_lightning_tpu.telemetry import TelemetryConfig, span
+from ray_lightning_tpu.telemetry import metrics as _metrics
 from ray_lightning_tpu.utils.seed import reset_seed, seed_everything
 
 _log = logging.getLogger(__name__)
@@ -327,6 +328,14 @@ class Trainer:
         # program (jax compiles lazily at first dispatch)
         with span("compile"):
             self._build_compiled(module, example_batch, strategy)
+        _metrics.on_compile()
+        if _metrics.metrics_enabled():
+            # the gradient/param collectives XLA compiles into the step
+            # from the strategy's shardings have no host call site; the
+            # strategy declares their per-step byte cost so the metrics
+            # plane can charge it per executed step
+            _metrics.note_step_collectives(strategy.step_collective_bytes(
+                self._mesh, self._abstract_state))
         with span("init"):
             self._init_state(module, example_batch, strategy, ckpt_path)
 
@@ -837,9 +846,11 @@ class Trainer:
             batch = item.batch() if want_batch else None
             for cb in self.callbacks:
                 cb.on_train_batch_start(self, module, batch, item.batch_idx)
+        t0 = time.monotonic()
         with span("step", step=self.global_step):
             metrics = source.run_one(self, item)
         self.global_step += 1
+        _metrics.on_step(time.monotonic() - t0, step=self.global_step)
         self._accumulate_metrics(metrics)
         if self.global_step % self.log_every_n_steps == 0:
             self._publish_metrics(metrics)
@@ -862,9 +873,12 @@ class Trainer:
         before = self.global_step
         # k steps ride one span; the aggregator normalizes per-step time
         # by the "k" attribute when computing percentiles
+        t0 = time.monotonic()
         with span("step", step=before, k=len(items)):
             metrics = source.run_chunk(self, items)
         self.global_step += len(items)
+        _metrics.on_step(time.monotonic() - t0, k=len(items),
+                         step=self.global_step)
         self._accumulate_metrics(metrics)
         self._publish_if_crossed(before, jax.tree_util.tree_map(
             lambda a: a[-1], metrics))

@@ -32,6 +32,8 @@ from jax.sharding import PartitionSpec as P
 
 from ray_lightning_tpu.parallel.mesh import get_current_mesh
 from ray_lightning_tpu.parallel.strategy import SpmdStrategy
+from ray_lightning_tpu.telemetry.metrics import note_traced_collective
+from ray_lightning_tpu.parallel.ring import _tensor_bytes
 
 
 def _scan_layers(stage_fn, params_stacked, h):
@@ -127,6 +129,17 @@ def pipeline_forward(stage_fn: Callable[[Any, jax.Array], jax.Array],
         raise ValueError(
             f"per-data-shard batch {x.shape[0]}//{data_size} does not "
             f"divide into {n_microbatches} microbatches")
+    # fabric traffic per invocation (trace-time accounting, charged per
+    # executed step by telemetry.metrics): every GPipe time step each of
+    # the S stages ppermutes one microbatch-sized activation block per
+    # data shard — global bytes x_bytes/M per stage — over M+S-1 time
+    # steps, plus the final psum broadcasting the last stage's outputs
+    # (logical payload: the full activation tensor once).
+    x_bytes = _tensor_bytes(x)
+    note_traced_collective(
+        "pipeline", S * (n_microbatches + S - 1) * x_bytes
+        // n_microbatches + x_bytes)
+
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name),
                                          stacked_params)
     x_spec = P(dp)

@@ -28,8 +28,19 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ray_lightning_tpu.parallel.mesh import get_current_mesh
+from ray_lightning_tpu.telemetry.metrics import note_traced_collective
 
 NEG_INF = -1e30
+
+
+def _tensor_bytes(x) -> int:
+    """Byte size from shape/dtype only — works on tracers (this runs at
+    trace time, inside jit)."""
+    import numpy as np
+    size = 1
+    for d in x.shape:
+        size *= int(d)
+    return size * np.dtype(x.dtype).itemsize
 
 
 def _block_update(carry, q, k_blk, v_blk, q_off, k_off, causal, scale):
@@ -130,6 +141,14 @@ def ring_attention(q, k, v, *, causal: bool = True, dtype=jnp.bfloat16,
     if ring == 1:
         return blockwise_attention(q, k, v, causal=causal, dtype=dtype,
                                    sm_scale=scale)
+
+    # fabric traffic per invocation: every rotation moves each device's
+    # local K/V block one hop, so ring devices together move the full
+    # global K+V per rotation, (ring-1) rotations per call.  This runs
+    # at trace time (the call sits inside the jitted step); the traced
+    # cost is charged once per executed step by telemetry.metrics.
+    note_traced_collective(
+        "ring", (ring - 1) * (_tensor_bytes(k) + _tensor_bytes(v)))
 
     from ray_lightning_tpu.parallel.mesh import data_and_tensor_axes
     dp, tensor = data_and_tensor_axes(mesh)

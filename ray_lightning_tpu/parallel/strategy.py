@@ -167,6 +167,27 @@ class ShardingStrategy:
     def data_parallel_size(self, mesh: Mesh) -> int:
         return mesh_axis_size(mesh, *self.data_axis_names)
 
+    @staticmethod
+    def _tree_bytes(tree) -> int:
+        import numpy as np
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            total += int(np.prod(getattr(leaf, "shape", ()),
+                                 dtype=np.int64)) \
+                * np.dtype(leaf.dtype).itemsize
+        return total
+
+    def step_collective_bytes(self, mesh: Mesh, abstract_state) -> dict:
+        """op -> logical payload bytes ONE optimizer step moves through
+        the fabric as a consequence of this strategy's sharding
+        annotations (XLA compiles the collectives into the step, so the
+        metrics plane accounts them from the annotation, not a call
+        site).  Pure DDP: one gradient all-reduce the size of the
+        params."""
+        if self.data_parallel_size(mesh) <= 1:
+            return {}
+        return {"grad_all_reduce": self._tree_bytes(abstract_state.params)}
+
     # Strategies are part of the plugin config pickled driver→worker; they
     # hold no live handles so default pickling is fine.
 
@@ -209,6 +230,18 @@ class Zero1Strategy(ShardingStrategy):
         if aval.size < max(2, self.min_shard_elements):
             return P()
         return _axis_spec(aval.shape, "data", mesh.shape["data"])
+
+    def step_collective_bytes(self, mesh: Mesh, abstract_state) -> dict:
+        """ZeRO step traffic: grads reduce-scatter into the sharded
+        update, updated params all-gather back out — each one params'
+        worth of logical payload (whether XLA lowers the pair literally
+        or as all-reduce + slice, the bytes on the wire are the OSS
+        story — see class docstring)."""
+        if self.data_parallel_size(mesh) <= 1:
+            return {}
+        params = self._tree_bytes(abstract_state.params)
+        return {"grad_reduce_scatter": params,
+                "param_all_gather": params}
 
 
 class FullyShardedStrategy(Zero1Strategy):

@@ -44,8 +44,10 @@ class LocalPlugin(ExecutionPlugin):
         if cfg is None or not cfg.enabled:
             return trainer._run_stage(module, datamodule, stage, ckpt_path)
         # single-process run: recorder and aggregator share the process,
-        # so the span sink feeds the aggregator directly (no queue hop)
+        # so the span/metrics sinks feed the aggregator directly (no
+        # queue hop)
         from ray_lightning_tpu import telemetry
+        from ray_lightning_tpu.telemetry import exporter as _exporter
         agg = telemetry.TelemetryAggregator(
             cfg.resolve_dir(trainer.default_root_dir),
             heartbeat_timeout=cfg.heartbeat_timeout,
@@ -53,13 +55,24 @@ class LocalPlugin(ExecutionPlugin):
         telemetry.set_active(agg)
         telemetry.enable(rank=0, sink=lambda recs: agg.ingest_records(
             0, recs), capacity=cfg.capacity, flush_every=cfg.flush_every)
+        server = None
+        if cfg.metrics:
+            telemetry.enable_metrics(rank=0, sink=agg.ingest_metrics,
+                                     interval=cfg.metrics_interval)
+            server = _exporter.start_metrics_server(agg, cfg)
         try:
             return trainer._run_stage(module, datamodule, stage, ckpt_path)
         finally:
+            telemetry.flush_metrics()
+            telemetry.disable_metrics()
             telemetry.flush()
             telemetry.disable()
             telemetry.set_active(None)
+            if server is not None:
+                server.stop()
             trainer._telemetry_paths = agg.export()
+            if server is not None:
+                trainer._telemetry_paths["metrics_url"] = server.url
 
     def local_devices(self):
         if self._devices is not None:

@@ -119,10 +119,11 @@ def _worker_run(payload: tuple, rank: int, queue) -> Optional[dict]:
 
 
 def _setup_worker_telemetry(trainer, rank: int, queue):
-    """Enable span recording + heartbeats inside an actor: span batches
-    and beats ride the worker→driver queue to the driver aggregator.
-    Returns the heartbeat sender to stop (None when telemetry is off or
-    the process-level sender from worker_main already beats)."""
+    """Enable span recording, the metrics registry and heartbeats inside
+    an actor: span batches and cumulative metrics windows ride the
+    worker→driver queue to the driver aggregator.  Returns the heartbeat
+    sender to stop (None when telemetry is off or the process-level
+    sender from worker_main already beats)."""
     cfg = getattr(trainer, "telemetry", None)
     if cfg is None or not cfg.enabled or queue is None:
         return None
@@ -134,6 +135,11 @@ def _setup_worker_telemetry(trainer, rank: int, queue):
 
     telemetry.enable(rank=rank, sink=sink, capacity=cfg.capacity,
                      flush_every=cfg.flush_every)
+    if cfg.metrics:
+        telemetry.enable_metrics(
+            rank=rank,
+            sink=lambda item, _q=queue, _rank=rank: _q.put((_rank, item)),
+            interval=cfg.metrics_interval)
     if hb_mod.process_heartbeat_active():
         return None  # worker_main (built-in backend) already beats
     return hb_mod.HeartbeatSender(
@@ -146,6 +152,10 @@ def _teardown_worker_telemetry(trainer, hb) -> None:
     if cfg is None or not cfg.enabled:
         return
     from ray_lightning_tpu import telemetry
+    # final metrics window first: its cumulative counters must be on the
+    # queue before the spans flush that follows the last step
+    telemetry.flush_metrics()
+    telemetry.disable_metrics()
     telemetry.flush()
     telemetry.disable()
     if hb is not None:
@@ -202,6 +212,7 @@ class RayXlaPlugin(ExecutionPlugin):
         state["_backend"] = None
         state["init_hook"] = None  # already executed before shipping
         state.pop("_telemetry_agg", None)  # live driver-side aggregator
+        state.pop("_metrics_server", None)  # live driver HTTP listener
         return state
 
     def __setstate__(self, state):
@@ -268,8 +279,10 @@ class RayXlaPlugin(ExecutionPlugin):
             for i in range(self.num_workers)
         ]
         agg = None
+        server = None
         if cfg.enabled:
             from ray_lightning_tpu import telemetry
+            from ray_lightning_tpu.telemetry import exporter as _exporter
             agg = telemetry.TelemetryAggregator(
                 cfg.resolve_dir(trainer.default_root_dir),
                 heartbeat_timeout=cfg.heartbeat_timeout,
@@ -278,6 +291,11 @@ class RayXlaPlugin(ExecutionPlugin):
                 agg.register_worker(i, w)
             telemetry.set_active(agg)
             self._telemetry_agg = agg
+            if cfg.metrics:
+                # live /metrics + /status on the driver: workers' metric
+                # windows arrive over the queue during _execution_loop
+                server = _exporter.start_metrics_server(agg, cfg)
+                self._metrics_server = server
         try:
             return self._execution_loop(trainer, module, datamodule, stage,
                                         ckpt_path, backend)
@@ -288,7 +306,11 @@ class RayXlaPlugin(ExecutionPlugin):
             if agg is not None:
                 from ray_lightning_tpu import telemetry
                 telemetry.set_active(None)
+                if server is not None:
+                    server.stop()
                 trainer._telemetry_paths = agg.export()
+                if server is not None:
+                    trainer._telemetry_paths["metrics_url"] = server.url
 
     def _execution_loop(self, trainer, module, datamodule, stage, ckpt_path,
                         backend):

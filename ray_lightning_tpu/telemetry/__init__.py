@@ -43,6 +43,20 @@ from ray_lightning_tpu.telemetry.aggregator import (  # noqa: F401
     set_active,
     spans_item,
 )
+from ray_lightning_tpu.telemetry.metrics import (  # noqa: F401
+    MetricsRegistry,
+    disable_metrics,
+    enable_metrics,
+    flush_metrics,
+    get_registry,
+    metrics_enabled,
+    metrics_item,
+    note_step_collectives,
+    note_traced_collective,
+    on_compile,
+    on_step,
+    record_collective,
+)
 
 __all__ = [
     "TelemetryConfig",
@@ -61,6 +75,18 @@ __all__ = [
     "get_active",
     "set_active",
     "spans_item",
+    "MetricsRegistry",
+    "enable_metrics",
+    "disable_metrics",
+    "metrics_enabled",
+    "flush_metrics",
+    "get_registry",
+    "metrics_item",
+    "record_collective",
+    "note_traced_collective",
+    "note_step_collectives",
+    "on_step",
+    "on_compile",
 ]
 
 
@@ -79,6 +105,16 @@ class TelemetryConfig:
     hard_timeout: Optional[float] = None
     flush_every: int = 256
     capacity: int = 65536
+    #: metrics plane (telemetry/metrics.py): per-rank typed instruments
+    #: (HBM gauges, step-time histogram, collective byte counters)
+    #: riding the same worker→driver channel as spans
+    metrics: bool = True
+    #: seconds between device-state samples / window flushes
+    metrics_interval: float = 2.0
+    #: driver HTTP endpoint (/metrics Prometheus exposition + /status
+    #: JSON).  None = no server unless RLT_METRICS_PORT is set; 0 = an
+    #: ephemeral port (read it back from the returned metrics_url)
+    metrics_port: Optional[int] = None
 
     @classmethod
     def resolve(cls, value: Any) -> "TelemetryConfig":
@@ -99,6 +135,23 @@ class TelemetryConfig:
         raise TypeError(
             f"telemetry must be None/bool/dict/TelemetryConfig; got "
             f"{type(value).__name__}")
+
+    def resolved_metrics_port(self) -> Optional[int]:
+        """Port for the driver's /metrics endpoint: the explicit config
+        field, else the ``RLT_METRICS_PORT`` env var, else None (no
+        server)."""
+        if self.metrics_port is not None:
+            return int(self.metrics_port)
+        env = os.environ.get("RLT_METRICS_PORT", "").strip()
+        if env:
+            try:
+                return int(env)
+            except ValueError:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "RLT_METRICS_PORT=%r is not an integer; metrics "
+                    "endpoint disabled", env)
+        return None
 
     def resolve_dir(self, default_root_dir: str) -> str:
         if self.dir:

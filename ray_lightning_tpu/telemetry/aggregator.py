@@ -11,6 +11,11 @@ aggregator:
   ``telemetry.jsonl`` record stream next to the CSVLogger output;
 - computes per-rank step-time percentiles and straggler skew
   (max/min of per-rank mean step time);
+- ingests per-rank cumulative metrics windows (telemetry/metrics.py),
+  keeps the window stream for ``metrics.jsonl``, and derives per-rank /
+  per-op collective achieved bandwidth (GiB/s) and HBM peaks into the
+  summary — the numbers the live ``/metrics`` exposition
+  (telemetry/exporter.py) serves while the run is still going;
 - runs the heartbeat watchdog: a rank that was beating and stopped for
   longer than ``heartbeat_timeout`` gets a driver log line naming the
   rank, its last span and heartbeat age — the "which worker wedged"
@@ -89,6 +94,13 @@ class TelemetryAggregator:
         self._workers: dict[int, Any] = {}   # rank -> ActorHandle
         self._warned: set[int] = set()
         self._diagnosed = False
+        #: metrics plane (telemetry/metrics.py): full window stream for
+        #: metrics.jsonl (bounded) + latest cumulative window per rank
+        self._metric_windows: list[dict] = []
+        self._metric_windows_cap = 20000
+        self._metric_windows_dropped = 0
+        self._metrics_latest: dict[int, dict] = {}
+        self._metrics_first_ts: dict[int, float] = {}
 
     # -- ingestion -------------------------------------------------------
 
@@ -105,7 +117,27 @@ class TelemetryAggregator:
             self.ingest_records(item.get("rank", -1), item["records"])
         elif kind == "heartbeat":
             self._note_heartbeat(item)
+        elif kind == "metrics":
+            self.ingest_metrics(item)
         return True
+
+    def ingest_metrics(self, item: dict) -> None:
+        """One cumulative metrics window from a rank: keep the stream
+        (for metrics.jsonl) and the latest state (for /metrics)."""
+        rank = item.get("rank", -1)
+        with self._lock:
+            if len(self._metric_windows) >= self._metric_windows_cap:
+                self._metric_windows.pop(0)
+                self._metric_windows_dropped += 1
+            self._metric_windows.append(item)
+            self._metrics_latest[rank] = item
+            self._metrics_first_ts.setdefault(
+                rank, item.get("ts", time.time()))
+
+    def latest_metrics(self) -> dict[int, dict]:
+        """rank -> latest cumulative metrics window (exporter surface)."""
+        with self._lock:
+            return dict(self._metrics_latest)
 
     def ingest_records(self, rank: int, records: list[dict]) -> None:
         for r in records:
@@ -121,9 +153,38 @@ class TelemetryAggregator:
             self._warned.discard(key)
 
     def heartbeats(self) -> dict:
-        """Latest beat per worker process (tests/diagnostics)."""
+        """Latest beat per worker process, with its current age on the
+        driver clock (tests/diagnostics/status endpoint)."""
+        now = self._clock()
         with self._lock:
-            return {k: dict(v) for k, v in self._hb.items()}
+            return {k: {**v, "age": now - v["at"]}
+                    for k, v in self._hb.items()}
+
+    def metrics_briefs(self) -> dict[int, dict]:
+        """rank -> {step, hbm_bytes, last_collective}: the latest
+        heartbeat-carried brief, falling back to values derivable from
+        the rank's latest metrics window (in-process runs have metrics
+        but no heartbeats)."""
+        out: dict[int, dict] = {}
+        for rank, item in self.latest_metrics().items():
+            brief: dict = {}
+            for m in item.get("metrics", ()):
+                if m["name"] == "rlt_steps_total":
+                    brief["step"] = int(m.get("value", 0))
+                elif m["name"] == "rlt_hbm_bytes" and \
+                        (m.get("labels") or {}).get("device") == "0":
+                    brief["hbm_bytes"] = int(m.get("value", 0))
+            if brief:
+                out[rank] = brief
+        with self._lock:
+            beats = [v["beat"] for v in self._hb.values()]
+        for beat in beats:
+            brief = beat.get("metrics")
+            rank = beat.get("rank", -1)
+            if brief:
+                out.setdefault(rank, {}).update(
+                    {k: v for k, v in brief.items() if v is not None})
+        return out
 
     # -- watchdog --------------------------------------------------------
 
@@ -132,9 +193,20 @@ class TelemetryAggregator:
         rank = beat.get("rank", -1)
         who = f"rank {rank}" if rank >= 0 else \
             f"unranked worker (actor {beat.get('actor_id')!r})"
+        # the heartbeat-carried metrics brief turns "went silent" into
+        # "went silent at step N during a reduce_scatter with X GiB HBM
+        # in use" — what the rank was doing, not just that it stopped
+        extra = ""
+        brief = beat.get("metrics") or {}
+        if brief.get("step") is not None:
+            extra += f", step {brief['step']}"
+        if brief.get("hbm_bytes"):
+            extra += f", hbm {brief['hbm_bytes'] / 2**30:.2f} GiB"
+        if brief.get("last_collective"):
+            extra += f", last collective {brief['last_collective']!r}"
         return (f"{who}: last heartbeat {age:.1f}s ago, last span "
-                f"{beat.get('last_span')!r}, pid {beat.get('pid')}, "
-                f"host {beat.get('host')}")
+                f"{beat.get('last_span')!r}{extra}, "
+                f"pid {beat.get('pid')}, host {beat.get('host')}")
 
     def _alive_note(self, rank: int) -> str:
         handle = self._workers.get(rank)
@@ -208,12 +280,96 @@ class TelemetryAggregator:
                 "mean_ms": round(mean, 3),
                 "p50_ms": round(_percentile(ds, 50), 3),
                 "p90_ms": round(_percentile(ds, 90), 3),
+                "p95_ms": round(_percentile(ds, 95), 3),
                 "max_ms": round(ds[-1], 3),
             }
         if len(means) >= 2 and min(means) > 0:
             # straggler skew: how much slower the slowest rank's mean
             # step is than the fastest rank's (1.0 = perfectly even)
             out["straggler_skew"] = round(max(means) / min(means), 3)
+        return out
+
+    # -- metrics derivations ---------------------------------------------
+
+    def _rank_step_seconds(self) -> dict[int, float]:
+        """Total recorded step-span time per rank — the bandwidth
+        denominator for collectives compiled into the step program."""
+        out: dict[int, float] = {}
+        with self._lock:
+            records = list(self._records)
+        for r in records:
+            if r.get("t") == "span" and r.get("name") == "step":
+                rank = r.get("rank", -1)
+                out[rank] = out.get(rank, 0.0) + float(r.get("dur", 0.0))
+        return out
+
+    @staticmethod
+    def _window_values(item: dict, name: str) -> list[tuple[dict, float]]:
+        return [((m.get("labels") or {}), float(m.get("value", 0.0)))
+                for m in item.get("metrics", ()) if m["name"] == name]
+
+    def collective_stats(self) -> dict:
+        """Per-op byte totals and achieved GiB/s, per rank and summed.
+
+        Denominator preference per (rank, op): measured op seconds
+        (host-dispatched collectives record them) → the rank's total
+        step-span time (traced in-step collectives overlap with the
+        step) → elapsed wall time between the rank's first and latest
+        metrics window.  The step/wall denominators make the figure a
+        lower bound on fabric bandwidth — the transfer shares the
+        denominator with compute — which is exactly the "achieved"
+        number a comms optimization must move."""
+        step_secs = self._rank_step_seconds()
+        latest = self.latest_metrics()
+        with self._lock:
+            first_ts = dict(self._metrics_first_ts)
+        per_op: dict[str, dict] = {}
+        for rank, item in latest.items():
+            secs_by_op = {labels.get("op"): v for labels, v in
+                          self._window_values(
+                              item, "rlt_collective_seconds_total")}
+            elapsed = max(0.0, item.get("ts", 0.0)
+                          - first_ts.get(rank, item.get("ts", 0.0)))
+            for labels, nbytes in self._window_values(
+                    item, "rlt_collective_bytes_total"):
+                op = labels.get("op", "?")
+                if nbytes <= 0:
+                    continue
+                denom = secs_by_op.get(op) or step_secs.get(rank) \
+                    or elapsed
+                gibs = round(nbytes / denom / 2**30, 6) if denom else None
+                entry = per_op.setdefault(
+                    op, {"bytes": 0, "gibs": 0.0, "per_rank": {}})
+                entry["bytes"] += int(nbytes)
+                entry["per_rank"][str(rank)] = {
+                    "bytes": int(nbytes), "gibs": gibs}
+                if gibs:
+                    # ranks move their shares concurrently: job-level
+                    # achieved bandwidth is the sum of per-rank rates
+                    entry["gibs"] = round(entry["gibs"] + gibs, 6)
+        return per_op
+
+    def hbm_stats(self) -> dict[str, int]:
+        """Per-rank peak HBM bytes (device 0) from the latest windows."""
+        out: dict[str, int] = {}
+        for rank, item in self.latest_metrics().items():
+            peaks = [v for labels, v in self._window_values(
+                item, "rlt_hbm_peak_bytes")]
+            if peaks:
+                out[str(rank)] = int(max(peaks))
+        return out
+
+    def dropped_stats(self) -> dict[str, int]:
+        """Per-rank telemetry ring-buffer drop counts — silent data loss
+        the summary must surface (a trace with holes must say so)."""
+        out: dict[str, int] = {}
+        for rank, item in self.latest_metrics().items():
+            for _labels, v in self._window_values(
+                    item, "rlt_telemetry_dropped_total"):
+                if v > 0:
+                    out[str(rank)] = int(v)
+        if self._metric_windows_dropped:
+            out["driver_windows"] = self._metric_windows_dropped
         return out
 
     # -- export ----------------------------------------------------------
@@ -241,13 +397,15 @@ class TelemetryAggregator:
         return events
 
     def export(self) -> dict:
-        """Write ``trace.json`` (Chrome/Perfetto) and ``telemetry.jsonl``
+        """Write ``trace.json`` (Chrome/Perfetto), ``telemetry.jsonl``
+        and — when any metrics windows arrived — ``metrics.jsonl``
         under ``out_dir``; returns their paths plus the summary dict."""
         os.makedirs(self.out_dir, exist_ok=True)
         trace_path = os.path.join(self.out_dir, "trace.json")
         jsonl_path = os.path.join(self.out_dir, "telemetry.jsonl")
         with self._lock:
             records = list(self._records)
+            windows = list(self._metric_windows)
         stats = self.step_stats()
         summary = {
             "t": "summary",
@@ -255,6 +413,28 @@ class TelemetryAggregator:
             "ranks": sorted({r.get("rank", -1) for r in records}),
             "step_stats": stats,
         }
+        collectives = self.collective_stats()
+        hbm = self.hbm_stats()
+        dropped = self.dropped_stats()
+        if windows:
+            summary["metrics"] = {
+                "windows": len(windows),
+                "collectives": collectives,
+                "hbm_peak_bytes": hbm,
+                "dropped_records": dropped,
+            }
+            # scalar conveniences for bench JSON lines / quick greps
+            summary["hbm_peak_bytes"] = max(hbm.values()) if hbm else 0
+            summary["collective_gibs"] = round(
+                sum(v.get("gibs") or 0.0 for v in collectives.values()),
+                6)
+        if dropped:
+            # data loss must be loud: a trace/metrics stream with holes
+            # silently reads as "nothing happened there"
+            _log.warning(
+                "telemetry: ring buffers dropped records (per rank: %s) "
+                "— raise TelemetryConfig.capacity or lower flush_every "
+                "to capture the full stream", dropped)
         tmp = trace_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"traceEvents": self._trace_events(records),
@@ -266,10 +446,20 @@ class TelemetryAggregator:
                 f.write(json.dumps(r) + "\n")
             f.write(json.dumps(summary) + "\n")
         os.replace(tmp, jsonl_path)
+        out = {"trace": trace_path, "jsonl": jsonl_path,
+               "summary": summary}
+        if windows:
+            metrics_path = os.path.join(self.out_dir, "metrics.jsonl")
+            tmp = metrics_path + ".tmp"
+            with open(tmp, "w") as f:
+                for w in windows:
+                    f.write(json.dumps(w) + "\n")
+                f.write(json.dumps(summary) + "\n")
+            os.replace(tmp, metrics_path)
+            out["metrics"] = metrics_path
         skew = stats.get("straggler_skew")
         _log.info(
             "telemetry: %d records from ranks %s -> %s%s", len(records),
             summary["ranks"], trace_path,
             f" (straggler skew {skew})" if skew else "")
-        return {"trace": trace_path, "jsonl": jsonl_path,
-                "summary": summary}
+        return out

@@ -1,0 +1,195 @@
+"""Driver-side live metrics endpoint: Prometheus exposition + status.
+
+The aggregator (telemetry/aggregator.py) already holds every rank's
+latest cumulative metrics window; this module makes that state
+scrapable while the run is live:
+
+- :func:`render_prometheus` — text exposition (format 0.0.4) of every
+  per-rank instrument, each series carrying a ``rank`` label so one
+  scrape covers the whole job (the TorchTitan-style per-rank
+  throughput/memory surface, PAPERS.md).
+- :class:`MetricsHTTPServer` — a stdlib ``http.server`` thread on the
+  driver serving ``GET /metrics`` (exposition) and ``GET /status``
+  (JSON: per-rank heartbeat age, current step, step p50/p95, HBM, last
+  collective — the "is it healthy right now" complement to the
+  post-hoc Perfetto trace).
+
+No third-party client library: the exposition format is a few lines of
+text, and the driver must stay dependency-free (ROADMAP constraint).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_log = logging.getLogger(__name__)
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def render_prometheus(aggregator) -> str:
+    """Text exposition of every rank's latest metrics window."""
+    by_name: dict[str, list[tuple]] = {}   # name -> [(rank, metric)]
+    types: dict[str, str] = {}
+    for rank, item in sorted(aggregator.latest_metrics().items()):
+        for m in item.get("metrics", ()):
+            by_name.setdefault(m["name"], []).append((rank, m))
+            types[m["name"]] = m.get("type", "gauge")
+    lines: list[str] = []
+    for name in sorted(by_name):
+        lines.append(f"# TYPE {name} {types[name]}")
+        for rank, m in by_name[name]:
+            labels = dict(m.get("labels") or {})
+            labels["rank"] = str(rank)
+            if m.get("type") == "histogram":
+                cum = 0
+                bounds = list(m.get("buckets", ())) + ["+Inf"]
+                for bound, count in zip(bounds, m.get("counts", ())):
+                    cum += count
+                    blabels = dict(labels)
+                    blabels["le"] = (bound if bound == "+Inf"
+                                     else _fmt_value(bound))
+                    lines.append(f"{name}_bucket{_fmt_labels(blabels)} "
+                                 f"{cum}")
+                lines.append(f"{name}_sum{_fmt_labels(labels)} "
+                             f"{_fmt_value(m.get('sum', 0.0))}")
+                lines.append(f"{name}_count{_fmt_labels(labels)} "
+                             f"{int(m.get('count', 0))}")
+            else:
+                lines.append(f"{name}{_fmt_labels(labels)} "
+                             f"{_fmt_value(m.get('value', 0.0))}")
+    return "\n".join(lines) + "\n"
+
+
+def render_status(aggregator) -> dict:
+    """JSON status document: one entry per rank with liveness +
+    progress + step latency percentiles."""
+    stats = aggregator.step_stats().get("per_rank", {})
+    briefs = aggregator.metrics_briefs()
+    ranks: dict[str, dict] = {}
+    for key, hb in aggregator.heartbeats().items():
+        beat = hb.get("beat", {})
+        rank = beat.get("rank", key)
+        entry = ranks.setdefault(str(rank), {})
+        entry["heartbeat_age_s"] = round(hb.get("age", 0.0), 3)
+        entry["last_span"] = beat.get("last_span")
+    for rank, brief in briefs.items():
+        entry = ranks.setdefault(str(rank), {})
+        entry["step"] = brief.get("step")
+        entry["hbm_bytes"] = brief.get("hbm_bytes")
+        entry["last_collective"] = brief.get("last_collective")
+    for rank, st in stats.items():
+        entry = ranks.setdefault(str(rank), {})
+        entry["step_p50_ms"] = st.get("p50_ms")
+        entry["step_p95_ms"] = st.get("p95_ms")
+        entry["steps_recorded"] = st.get("steps")
+    return {"ranks": ranks}
+
+
+class MetricsHTTPServer:
+    """`GET /metrics` + `GET /status` on the driver, backed by the live
+    aggregator.  Port 0 binds an ephemeral port (read it back from
+    ``.port``) — the default inside builtin-tune trials so concurrent
+    trials never collide."""
+
+    def __init__(self, aggregator, port: int = 0,
+                 host: str = "127.0.0.1"):
+        agg = aggregator
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):          # noqa: N802 - stdlib API name
+                try:
+                    if self.path.split("?")[0] == "/metrics":
+                        body = render_prometheus(agg).encode()
+                        ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    elif self.path.split("?")[0] == "/status":
+                        body = json.dumps(render_status(agg)).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception:      # a scrape must never crash a run
+                    _log.warning("metrics endpoint failed", exc_info=True)
+                    self.send_error(500)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):   # scrapes are not log events
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="rlt-metrics-http")
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "MetricsHTTPServer":
+        self._thread.start()
+        _log.info("metrics exporter: serving /metrics and /status at %s",
+                  self.url)
+        return self
+
+    def stop(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+
+
+def start_metrics_server(aggregator, cfg) -> Optional[MetricsHTTPServer]:
+    """Start the driver endpoint when the config asks for one.
+
+    Port resolution: ``TelemetryConfig.metrics_port`` or the
+    ``RLT_METRICS_PORT`` env var; None = no server.  Inside a builtin
+    tune trial an explicit non-zero port is downgraded to ephemeral —
+    concurrent trials each get their own listener instead of one
+    winning the bind and the rest crashing."""
+    port = cfg.resolved_metrics_port()
+    if port is None:
+        return None
+    trial = None
+    try:
+        from ray_lightning_tpu.tune.session import get_trial
+        trial = get_trial()
+    except Exception:
+        pass
+    if port != 0 and trial is not None:
+        _log.info("metrics exporter: inside a tune trial; using "
+                  "an ephemeral port instead of %d", port)
+        port = 0
+    try:
+        server = MetricsHTTPServer(aggregator, port=port).start()
+    except OSError as e:
+        _log.warning("metrics exporter: could not bind port %s (%s); "
+                     "run continues without a live endpoint", port, e)
+        return None
+    if trial is not None:
+        # which port this trial landed on, for ExperimentAnalysis /
+        # dashboards scraping a fleet of concurrent trials
+        trial.metrics_url = server.url
+    return server

@@ -32,7 +32,7 @@ _process_sender: "Optional[HeartbeatSender]" = None
 
 
 def make_heartbeat(rank: int, actor_id: Optional[str] = None) -> dict:
-    return {
+    beat = {
         TELEMETRY_KEY: 1,
         "kind": "heartbeat",
         "rank": rank,
@@ -42,6 +42,14 @@ def make_heartbeat(rank: int, actor_id: Optional[str] = None) -> dict:
         "wall": time.time(),
         "last_span": spans.last_span(),
     }
+    # latest metrics brief (step, HBM, last collective) so a wedged
+    # rank's watchdog diagnosis says WHAT it was doing when it went
+    # silent, not just that it did (telemetry/metrics.py)
+    from ray_lightning_tpu.telemetry.metrics import metrics_brief
+    brief = metrics_brief()
+    if brief is not None:
+        beat["metrics"] = brief
+    return beat
 
 
 def _env_rank() -> int:

@@ -1,0 +1,499 @@
+"""Per-rank metrics plane: typed instruments + device/collective accounting.
+
+PR 1 gave the run a *trace* plane (spans, heartbeats, Perfetto export);
+this module adds the *numeric* plane standard monitoring infra can
+scrape and alert on (TorchTitan treats per-rank throughput/memory
+metrics as a production requirement — PAPERS.md):
+
+- A process-wide :class:`MetricsRegistry` of typed instruments
+  (:class:`Counter` / :class:`Gauge` / :class:`Histogram` with fixed
+  buckets).  Names are validated at registration: ``rlt_``-prefixed,
+  Prometheus-clean (``^rlt_[a-z0-9_]+$``) and carrying a unit suffix
+  (``_bytes`` / ``_seconds`` / ``_total``), so the driver's ``/metrics``
+  exposition never emits an unscrapable series.
+- Collective byte accounting.  Host-side collectives
+  (:func:`ray_lightning_tpu.parallel.gather.fetch_tree`) record bytes +
+  seconds directly (:func:`record_collective`).  Collectives *compiled
+  into* the step program (ring attention's ppermute rotation, the
+  pipeline's activation hops, the ZeRO reduce-scatter/all-gather the
+  sharding annotations imply) can only be observed at trace time — they
+  register a bytes-per-execution cost (:func:`note_traced_collective`)
+  that :func:`on_step` multiplies by executed steps, so the counters
+  track actual traffic, not trace count.
+- Device state sampling: a window pump thread reads
+  ``jax.local_devices()[i].memory_stats()`` into current/peak HBM
+  gauges each window and flushes the full cumulative snapshot to the
+  sink (the worker→driver queue under cluster backends, the aggregator
+  directly in-process).  Backends without memory stats (virtual CPU
+  devices) report 0 so the gauges still exist to scrape.
+
+Disabled is the default: every entry point checks one module global and
+returns; hot loops keep their instrumentation unconditionally.  Like
+spans.py, nothing heavy imports at module load (worker_main touches this
+package before jax exists); jax is imported lazily inside the sampler.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ray_lightning_tpu.telemetry import spans
+from ray_lightning_tpu.telemetry.aggregator import TELEMETRY_KEY
+
+_log = logging.getLogger(__name__)
+
+#: Prometheus-clean instrument name: rlt_ prefix, lowercase, and a unit
+#: suffix so the exposition is self-describing (satellite lint contract)
+NAME_RE = re.compile(r"^rlt_[a-z0-9_]+$")
+UNIT_SUFFIXES = ("_bytes", "_seconds", "_total")
+
+#: step-time histogram bounds (seconds): sub-ms dispatch latency up to
+#: multi-second giant-model steps
+STEP_TIME_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+#: every instrument the framework itself registers (kept in one place so
+#: the name lint — ``python -m ray_lightning_tpu.telemetry.metrics
+#: --check-names`` and tests/test_metrics.py — covers the full surface)
+CORE_METRICS = (
+    "rlt_steps_total",
+    "rlt_compiles_total",
+    "rlt_step_time_seconds",
+    "rlt_hbm_bytes",
+    "rlt_hbm_peak_bytes",
+    "rlt_collective_bytes_total",
+    "rlt_collective_ops_total",
+    "rlt_collective_seconds_total",
+    "rlt_data_wait_seconds_total",
+    "rlt_telemetry_dropped_total",
+)
+
+
+def validate_metric_name(name: str) -> str:
+    """Raise ValueError unless ``name`` is Prometheus-clean and carries
+    a unit suffix; returns the name for chaining."""
+    if not NAME_RE.match(name):
+        raise ValueError(
+            f"metric name {name!r} must match {NAME_RE.pattern}")
+    if not name.endswith(UNIT_SUFFIXES):
+        raise ValueError(
+            f"metric name {name!r} must end with a unit suffix "
+            f"{UNIT_SUFFIXES}")
+    return name
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonic cumulative value per label set."""
+
+    __slots__ = ("name", "_values", "_lock")
+
+    kind = "counter"
+
+    def __init__(self, name: str):
+        self.name = validate_metric_name(name)
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, value: float = 1.0, **labels: Any) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + float(value)
+
+    def value(self, **labels: Any) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            items = list(self._values.items())
+        return [{"name": self.name, "type": self.kind,
+                 "labels": dict(k), "value": v} for k, v in items]
+
+
+class Gauge(Counter):
+    """Point-in-time value per label set (same storage, set not add)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels: Any) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+
+class Histogram:
+    """Fixed-bucket cumulative histogram (Prometheus semantics: each
+    bucket counts observations <= its upper bound)."""
+
+    __slots__ = ("name", "buckets", "_counts", "_sum", "_count", "_lock")
+
+    kind = "histogram"
+
+    def __init__(self, name: str, buckets: tuple = STEP_TIME_BUCKETS):
+        self.name = validate_metric_name(name)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self.buckets) + 1)   # +1: +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        i = 0
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                break
+        else:
+            i = len(self.buckets)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return [{"name": self.name, "type": self.kind, "labels": {},
+                     "buckets": list(self.buckets),
+                     "counts": list(self._counts),
+                     "sum": self._sum, "count": self._count}]
+
+
+class MetricsRegistry:
+    """Per-process instrument registry + the window pump's data source.
+
+    ``snapshot()`` returns the full cumulative state (Prometheus-style:
+    the driver derives rates/bandwidth from deltas or elapsed time, the
+    worker never resets)."""
+
+    def __init__(self, rank: int = 0,
+                 sink: Optional[Callable[[dict], None]] = None):
+        self.rank = rank
+        self.sink = sink
+        self._instruments: dict[str, Any] = {}
+        self._lock = threading.Lock()
+        #: op -> bytes one execution of the compiled step moves (filled
+        #: at trace time; multiplied by executed steps in on_step)
+        self.traced_bytes: dict[str, int] = {}
+        self.last_collective: Optional[str] = None
+        self.current_step = 0
+        self.last_hbm_bytes = 0
+        self._sink_failed = False
+
+    # -- instruments -----------------------------------------------------
+
+    def _get(self, cls, name: str, **kw):
+        inst = self._instruments.get(name)
+        if inst is None:
+            with self._lock:
+                inst = self._instruments.get(name)
+                if inst is None:
+                    inst = self._instruments[name] = cls(name, **kw)
+        if not isinstance(inst, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as "
+                f"{type(inst).__name__}, requested {cls.__name__}")
+        return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(Counter, name)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(Gauge, name)
+
+    def histogram(self, name: str,
+                  buckets: tuple = STEP_TIME_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, buckets=buckets)
+
+    # -- device sampling -------------------------------------------------
+
+    def sample_device_state(self) -> None:
+        """Current/peak HBM per local device.  Profiler-less backends
+        (virtual CPU devices, some tunnels) report 0 — the gauges still
+        exist, so dashboards don't break per platform."""
+        cur = self.gauge("rlt_hbm_bytes")
+        peak = self.gauge("rlt_hbm_peak_bytes")
+        try:
+            import jax
+            devices = jax.local_devices()
+        except Exception:
+            devices = []
+        if not devices:
+            cur.set(0, device="0")
+            peak.set(0, device="0")
+            return
+        for i, dev in enumerate(devices):
+            try:
+                stats = dev.memory_stats() or {}
+            except Exception:
+                stats = {}
+            in_use = int(stats.get("bytes_in_use", 0) or 0)
+            cur.set(in_use, device=str(i))
+            peak.set(int(stats.get("peak_bytes_in_use", 0) or 0),
+                     device=str(i))
+            if i == 0:
+                self.last_hbm_bytes = in_use
+
+    # -- snapshot / flush ------------------------------------------------
+
+    def snapshot(self) -> list[dict]:
+        # span/metric records lost to the ring buffer are data loss the
+        # driver must surface (satellite: silent-drop visibility)
+        self.gauge("rlt_telemetry_dropped_total").set(spans.dropped())
+        with self._lock:
+            instruments = list(self._instruments.values())
+        out: list[dict] = []
+        for inst in instruments:
+            out.extend(inst.snapshot())
+        return out
+
+    def flush(self) -> None:
+        if self.sink is None:
+            return
+        try:
+            self.sink(metrics_item(self.rank, self.snapshot()))
+        except Exception:
+            if not self._sink_failed:
+                self._sink_failed = True
+                _log.warning("metrics sink failed; further windows will "
+                             "be dropped silently", exc_info=True)
+
+    def brief(self) -> dict:
+        """Tiny state summary carried on heartbeats so the watchdog can
+        say what a wedged rank was *doing* (step, HBM, last collective),
+        not just that it went silent."""
+        return {"step": self.current_step,
+                "hbm_bytes": self.last_hbm_bytes,
+                "last_collective": self.last_collective}
+
+
+class _MetricsPump:
+    """Daemon thread sampling device state + flushing the snapshot every
+    ``interval`` seconds (and once at stop, so short runs still export
+    at least one window)."""
+
+    def __init__(self, registry: MetricsRegistry, interval: float = 2.0):
+        self._registry = registry
+        self._interval = max(0.05, float(interval))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="rlt-metrics-pump")
+
+    def start(self) -> "_MetricsPump":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self._window()
+        self._window()   # final flush on stop
+
+    def _window(self) -> None:
+        try:
+            self._registry.sample_device_state()
+        except Exception:   # sampling must never kill the pump
+            pass
+        self._registry.flush()
+
+
+def metrics_item(rank: int, snapshot: list[dict]) -> dict:
+    """Wire item carrying one cumulative metrics window (rides the same
+    worker→driver queue as span batches)."""
+    return {TELEMETRY_KEY: 1, "kind": "metrics", "rank": rank,
+            "ts": time.time(), "metrics": snapshot}
+
+
+_registry: Optional[MetricsRegistry] = None
+_pump: Optional[_MetricsPump] = None
+
+
+def enable_metrics(rank: int = 0,
+                   sink: Optional[Callable[[dict], None]] = None,
+                   interval: float = 2.0,
+                   pump: bool = True) -> MetricsRegistry:
+    """Install the process-wide registry (and its window pump when a
+    sink will consume the flushes)."""
+    global _registry, _pump
+    disable_metrics()
+    _registry = MetricsRegistry(rank=rank, sink=sink)
+    if pump and sink is not None:
+        _pump = _MetricsPump(_registry, interval=interval).start()
+    return _registry
+
+
+def disable_metrics() -> None:
+    global _registry, _pump
+    if _pump is not None:
+        _pump.stop()
+        _pump = None
+    _registry = None
+
+
+def metrics_enabled() -> bool:
+    return _registry is not None
+
+
+def get_registry() -> Optional[MetricsRegistry]:
+    return _registry
+
+
+def flush_metrics() -> None:
+    """Final window: sample + push the cumulative snapshot to the sink
+    (teardown paths call this before disable so the driver always sees
+    the run's last state)."""
+    reg = _registry
+    if reg is None:
+        return
+    try:
+        reg.sample_device_state()
+    except Exception:
+        pass
+    reg.flush()
+
+
+# -- hot-path entry points (all one-global-check no-ops when disabled) --
+
+def record_collective(op: str, nbytes: int,
+                      seconds: Optional[float] = None) -> None:
+    """Account one host-dispatched collective: ``nbytes`` of logical
+    payload moved by ``op`` (and how long it took, when measured —
+    seconds make the per-op achieved GiB/s exact instead of inferred)."""
+    reg = _registry
+    if reg is None:
+        return
+    reg.last_collective = op
+    reg.counter("rlt_collective_bytes_total").inc(nbytes, op=op)
+    reg.counter("rlt_collective_ops_total").inc(1, op=op)
+    if seconds is not None:
+        reg.counter("rlt_collective_seconds_total").inc(seconds, op=op)
+
+
+def note_traced_collective(op: str, nbytes_per_step: int) -> None:
+    """Register the byte cost of a collective compiled INTO the step
+    program (observed once at trace time, executed every step): each
+    :func:`on_step` then adds ``nbytes_per_step × k`` to the counters.
+    Re-tracing the same op overwrites (last trace wins) so recompiles
+    never double-count."""
+    reg = _registry
+    if reg is None:
+        return
+    reg.traced_bytes[op] = int(nbytes_per_step)
+    reg.last_collective = op
+
+
+def note_step_collectives(op_bytes: dict) -> None:
+    """Bulk :func:`note_traced_collective` (the trainer registers the
+    strategy's implied gradient/param collectives in one call)."""
+    reg = _registry
+    if reg is None:
+        return
+    for op, nbytes in (op_bytes or {}).items():
+        if nbytes > 0:
+            reg.traced_bytes[op] = int(nbytes)
+
+
+def on_step(duration_s: float, k: int = 1,
+            step: Optional[int] = None) -> None:
+    """Account one train dispatch: ``k`` optimizer steps in
+    ``duration_s`` host seconds.  Observes the per-step-normalized time
+    into the histogram, bumps the step counter, and charges every
+    traced-collective cost ``k`` times."""
+    reg = _registry
+    if reg is None:
+        return
+    k = max(1, int(k))
+    reg.histogram("rlt_step_time_seconds").observe(duration_s / k)
+    reg.counter("rlt_steps_total").inc(k)
+    if step is not None:
+        reg.current_step = int(step)
+    if reg.traced_bytes:
+        bytes_c = reg.counter("rlt_collective_bytes_total")
+        ops_c = reg.counter("rlt_collective_ops_total")
+        for op, nbytes in reg.traced_bytes.items():
+            bytes_c.inc(nbytes * k, op=op)
+            ops_c.inc(k, op=op)
+
+
+def on_compile() -> None:
+    reg = _registry
+    if reg is None:
+        return
+    reg.counter("rlt_compiles_total").inc(1)
+
+
+def on_data_wait(seconds: float) -> None:
+    """Cumulative host-side input-pipeline stall (the data_wait span's
+    numeric twin: scrape its rate against rlt_step_time_seconds to see
+    when the loader, not the device, is the bottleneck)."""
+    reg = _registry
+    if reg is None:
+        return
+    reg.counter("rlt_data_wait_seconds_total").inc(seconds)
+
+
+def metrics_brief() -> Optional[dict]:
+    """Heartbeat payload hook (None when the metrics plane is off)."""
+    reg = _registry
+    return reg.brief() if reg is not None else None
+
+
+# -- name lint (format.sh --check / tests/test_metrics.py) ---------------
+
+_REGISTRATION_RE = re.compile(
+    r"\b(?:counter|gauge|histogram)\(\s*['\"]([^'\"]+)['\"]")
+
+
+def lint_metric_names(package_root: Optional[str] = None) -> list[str]:
+    """Validate CORE_METRICS plus every name literal passed to a
+    counter()/gauge()/histogram() registration in the source tree.
+    Returns the list of violations (empty = clean)."""
+    import os
+    problems: list[str] = []
+    names = set(CORE_METRICS)
+    if package_root is None:
+        package_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+    for dirpath, _dirs, files in os.walk(package_root):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    src = f.read()
+            except OSError:
+                continue
+            names.update(_REGISTRATION_RE.findall(src))
+    for name in sorted(names):
+        try:
+            validate_metric_name(name)
+        except ValueError as e:
+            problems.append(str(e))
+    return problems
+
+
+def _main(argv: list[str]) -> int:
+    if "--check-names" in argv:
+        problems = lint_metric_names()
+        for p in problems:
+            print(f"metrics lint: {p}")
+        if not problems:
+            print(f"metrics lint: {len(CORE_METRICS)}+ instrument names "
+                  f"Prometheus-clean")
+        return 1 if problems else 0
+    print("usage: python -m ray_lightning_tpu.telemetry.metrics "
+          "--check-names")
+    return 2
+
+
+if __name__ == "__main__":   # pragma: no cover - exercised via format.sh
+    import sys
+    sys.exit(_main(sys.argv[1:]))

@@ -56,6 +56,12 @@ class Trial:
         #: resolves against the live trial session (tune/session.py), so
         #: concurrent trials never interleave into one shared dir
         self.telemetry_dir = os.path.join(logdir, "telemetry")
+        #: /metrics endpoint of the trial's Trainer when the metrics
+        #: exporter is enabled (always an ephemeral port inside a trial
+        #: — concurrent trials never contend for one bind); recorded by
+        #: telemetry/exporter.py; the listener dies with the trial's
+        #: run, so the URL is only live while the trial executes
+        self.metrics_url: Optional[str] = None
         #: device lease this trial ran on (in-process trials only;
         #: populated at first acquire — tune/session.py) for post-hoc
         #: "which chips ran this trial" debugging via ExperimentAnalysis

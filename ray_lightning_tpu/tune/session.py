@@ -153,3 +153,12 @@ def get_trial_id() -> str:
 def get_trial_dir() -> Optional[str]:
     s = _get()
     return s.trial.logdir if s else None
+
+
+def get_trial():
+    """The live Trial object, or None outside a builtin tune trial.
+    The metrics exporter (telemetry/exporter.py) uses it to give each
+    concurrent trial its own ephemeral /metrics port and to record the
+    bound URL on the trial for ExperimentAnalysis."""
+    s = _get()
+    return s.trial if s else None

@@ -1,0 +1,537 @@
+"""Metrics plane (telemetry/metrics.py + exporter.py): typed per-rank
+instruments, device/collective byte accounting, driver-side bandwidth
+derivation, and the live Prometheus endpoint.
+
+The e2e case mirrors the acceptance bar: a 2-worker local-backend fit
+with telemetry on must make ``GET /metrics`` on the driver return a
+Prometheus exposition with per-rank step-time histogram, HBM gauges and
+per-op collective byte counters, and the exported ``metrics.jsonl`` +
+summary must carry per-op achieved bandwidth (GiB/s).
+"""
+
+import json
+import logging
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import Trainer, telemetry
+from ray_lightning_tpu.models import BoringModel
+from ray_lightning_tpu.telemetry import metrics as M
+from ray_lightning_tpu.telemetry.aggregator import TelemetryAggregator
+from ray_lightning_tpu.telemetry.exporter import (
+    MetricsHTTPServer,
+    render_prometheus,
+    render_status,
+)
+from ray_lightning_tpu.telemetry.heartbeat import make_heartbeat
+
+from tests.utils import cpu_plugin
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    """Registry and recorder are process-ambient; never leak them."""
+    yield
+    telemetry.disable_metrics()
+    telemetry.disable()
+    telemetry.set_active(None)
+
+
+# -- instrument name convention (satellite: Prometheus-clean lint) ------
+
+def test_name_validation_accepts_core_and_rejects_dirty():
+    for name in M.CORE_METRICS:
+        assert M.validate_metric_name(name) == name
+    for bad in ("steps_total",            # missing rlt_ prefix
+                "rlt_StepTime_seconds",   # uppercase
+                "rlt_hbm",                # no unit suffix
+                "rlt_collective-bytes",   # dash
+                "rlt_steps_count"):       # unknown suffix
+        with pytest.raises(ValueError):
+            M.validate_metric_name(bad)
+
+
+def test_lint_covers_every_registered_name_in_tree():
+    # the same walk format.sh --check runs: every counter()/gauge()/
+    # histogram() literal in the package must be clean
+    assert M.lint_metric_names() == []
+
+
+def test_lint_flags_dirty_registration(tmp_path):
+    (tmp_path / "mod.py").write_text(
+        'reg.counter("torch_steps")\n')
+    problems = M.lint_metric_names(str(tmp_path))
+    assert len(problems) == 1 and "torch_steps" in problems[0]
+
+
+# -- typed instruments ---------------------------------------------------
+
+def test_counter_gauge_label_sets():
+    reg = M.MetricsRegistry()
+    c = reg.counter("rlt_collective_bytes_total")
+    c.inc(10, op="gather")
+    c.inc(5, op="gather")
+    c.inc(7, op="ring")
+    assert c.value(op="gather") == 15 and c.value(op="ring") == 7
+    g = reg.gauge("rlt_hbm_bytes")
+    g.set(100, device="0")
+    g.set(42, device="0")        # gauge: set, not add
+    assert g.value(device="0") == 42
+    snap = {(m["name"], tuple(sorted(m["labels"].items()))): m["value"]
+            for m in reg.snapshot()}
+    assert snap[("rlt_collective_bytes_total", (("op", "gather"),))] == 15
+
+
+def test_histogram_prometheus_bucket_semantics():
+    h = M.Histogram("rlt_step_time_seconds", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    (snap,) = h.snapshot()
+    assert snap["counts"] == [1, 2, 1]        # <=0.1, <=1.0, +Inf
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(6.05)
+
+
+def test_registry_rejects_type_conflicts():
+    reg = M.MetricsRegistry()
+    reg.counter("rlt_steps_total")
+    with pytest.raises(TypeError, match="already registered"):
+        reg.gauge("rlt_steps_total")
+
+
+def test_disabled_entry_points_are_noops():
+    assert not M.metrics_enabled()
+    M.record_collective("gather", 123)          # must not raise
+    M.note_traced_collective("ring", 456)
+    M.on_step(0.01)
+    M.on_compile()
+    M.on_data_wait(0.001)
+    assert M.metrics_brief() is None
+
+
+# -- collective accounting ----------------------------------------------
+
+def test_record_collective_bytes_ops_seconds():
+    reg = telemetry.enable_metrics(pump=False)
+    M.record_collective("gather", 1000, seconds=0.5)
+    M.record_collective("gather", 1000, seconds=0.5)
+    assert reg.counter("rlt_collective_bytes_total").value(op="gather") \
+        == 2000
+    assert reg.counter("rlt_collective_ops_total").value(op="gather") == 2
+    assert reg.counter("rlt_collective_seconds_total").value(op="gather") \
+        == pytest.approx(1.0)
+    assert reg.last_collective == "gather"
+
+
+def test_traced_collectives_charged_per_executed_step():
+    reg = telemetry.enable_metrics(pump=False)
+    M.note_traced_collective("ring", 100)
+    M.note_traced_collective("ring", 128)     # re-trace overwrites
+    M.note_step_collectives({"grad_reduce_scatter": 64,
+                             "param_all_gather": 64,
+                             "empty": 0})     # zero-cost ops dropped
+    M.on_step(0.01, k=3, step=3)
+    bytes_c = reg.counter("rlt_collective_bytes_total")
+    assert bytes_c.value(op="ring") == 128 * 3
+    assert bytes_c.value(op="grad_reduce_scatter") == 64 * 3
+    assert bytes_c.value(op="empty") == 0
+    assert reg.counter("rlt_collective_ops_total").value(op="ring") == 3
+    assert reg.counter("rlt_steps_total").value() == 3
+    assert reg.current_step == 3
+
+
+def test_ring_attention_registers_rotation_bytes():
+    from ray_lightning_tpu.parallel.mesh import (build_device_mesh,
+                                                 set_current_mesh)
+    reg = telemetry.enable_metrics(pump=False)
+    ring = 4
+    mesh = build_device_mesh(("data", "sequence"),
+                             {"data": 1, "sequence": ring},
+                             devices=jax.devices()[:ring])
+    try:
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(key, (2, 256, 2, 16), jnp.float32)
+                   for key in ks)
+        from ray_lightning_tpu.parallel.ring import ring_attention
+        try:
+            ring_attention(q, k, v, causal=True, dtype=jnp.float32,
+                           mesh=mesh)
+        except AttributeError:
+            # minimal-jax CI images lack jax.shard_map; the traced cost
+            # registers at call entry, before the shard_map dispatch, so
+            # the accounting under test is unaffected
+            pass
+    finally:
+        set_current_mesh(None)
+    # each rotation moves global K+V once; ring-1 rotations per call
+    expected = (ring - 1) * (k.size * 4 + v.size * 4)
+    assert reg.traced_bytes["ring"] == expected
+    M.on_step(0.01, k=2)
+    assert reg.counter("rlt_collective_bytes_total").value(op="ring") \
+        == 2 * expected
+
+
+def test_pipeline_registers_hop_bytes():
+    from jax.sharding import Mesh
+    from ray_lightning_tpu.parallel.pipeline import pipeline_forward
+    reg = telemetry.enable_metrics(pump=False)
+    S, mb = 2, 4
+    devs = np.array(jax.devices()[:S]).reshape(1, S)
+    params = {
+        "w": jax.random.normal(jax.random.PRNGKey(0), (8, 16, 16)) * 0.3,
+        "b": jnp.zeros((8, 16)),
+    }
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+    try:
+        pipeline_forward(lambda p, h: jnp.tanh(h @ p["w"] + p["b"]),
+                         params, x, n_microbatches=mb,
+                         mesh=Mesh(devs, ("data", "stage")))
+    except AttributeError:
+        pass   # jax.shard_map missing (see ring test note); the traced
+        # cost registers before the dispatch
+    x_bytes = x.size * 4
+    expected = S * (mb + S - 1) * x_bytes // mb + x_bytes
+    assert reg.traced_bytes["pipeline"] == expected > 0
+
+
+def test_strategy_step_collective_bytes():
+    from ray_lightning_tpu.parallel.mesh import build_device_mesh
+    from ray_lightning_tpu.parallel.strategy import (DataParallelStrategy,
+                                                     Zero1Strategy)
+    mesh = build_device_mesh(("data",), {"data": 4},
+                             devices=jax.devices()[:4])
+    params = {"w": jax.ShapeDtypeStruct((16, 16), jnp.float32),
+              "b": jax.ShapeDtypeStruct((16,), jnp.float32)}
+    state = SimpleNamespace(params=params)
+    nbytes = (16 * 16 + 16) * 4
+    assert DataParallelStrategy().step_collective_bytes(mesh, state) \
+        == {"grad_all_reduce": nbytes}
+    assert Zero1Strategy().step_collective_bytes(mesh, state) \
+        == {"grad_reduce_scatter": nbytes, "param_all_gather": nbytes}
+    one = build_device_mesh(("data",), {"data": 1},
+                            devices=jax.devices()[:1])
+    assert Zero1Strategy().step_collective_bytes(one, state) == {}
+
+
+# -- heartbeat brief (satellite: watchdog says WHAT a rank was doing) ---
+
+def test_heartbeat_carries_metrics_brief_and_watchdog_uses_it(tmp_path):
+    telemetry.enable_metrics(pump=False)
+    M.on_step(0.01, step=17)
+    M.record_collective("gather", 4096)
+    beat = make_heartbeat(5)
+    assert beat["metrics"]["step"] == 17
+    assert beat["metrics"]["last_collective"] == "gather"
+
+    clock = [0.0]
+    agg = TelemetryAggregator(str(tmp_path), heartbeat_timeout=5.0,
+                              clock=lambda: clock[0])
+    agg.maybe_ingest(beat)
+    clock[0] = 10.0
+    line = agg._describe(agg.heartbeats()[beat["pid"]]["beat"], 10.0)
+    assert "step 17" in line and "last collective 'gather'" in line
+
+
+# -- aggregator derivations ---------------------------------------------
+
+def _window(rank, metrics, ts=100.0):
+    return M.metrics_item(rank, metrics) | {"ts": ts}
+
+
+def _counter_m(name, value, **labels):
+    return {"name": name, "type": "counter", "labels": labels,
+            "value": value}
+
+
+def test_collective_bandwidth_prefers_measured_seconds(tmp_path):
+    agg = TelemetryAggregator(str(tmp_path))
+    gib = 2**30
+    for rank in (0, 1):
+        agg.maybe_ingest(_window(rank, [
+            _counter_m("rlt_collective_bytes_total", 2 * gib, op="gather"),
+            _counter_m("rlt_collective_seconds_total", 1.0, op="gather"),
+        ]))
+    stats = agg.collective_stats()
+    assert stats["gather"]["bytes"] == 4 * gib
+    assert stats["gather"]["per_rank"]["0"]["gibs"] == pytest.approx(2.0)
+    # ranks transfer concurrently: job bandwidth sums per-rank rates
+    assert stats["gather"]["gibs"] == pytest.approx(4.0)
+
+
+def test_collective_bandwidth_falls_back_to_step_time(tmp_path):
+    agg = TelemetryAggregator(str(tmp_path))
+    # 2 GiB of in-step (traced) collective, no measured seconds, but 4s
+    # of recorded step spans -> 0.5 GiB/s lower bound
+    agg.ingest_records(0, [{"t": "span", "name": "step", "ts": 100.0,
+                            "dur": 4.0, "rank": 0, "depth": 0}])
+    agg.maybe_ingest(_window(0, [
+        _counter_m("rlt_collective_bytes_total", 2 * 2**30, op="ring")]))
+    stats = agg.collective_stats()
+    assert stats["ring"]["per_rank"]["0"]["gibs"] == pytest.approx(0.5)
+
+
+def test_export_writes_metrics_jsonl_and_summary_fields(tmp_path, caplog):
+    agg = TelemetryAggregator(str(tmp_path))
+    agg.maybe_ingest(_window(0, [
+        _counter_m("rlt_collective_bytes_total", 2**30, op="gather"),
+        _counter_m("rlt_collective_seconds_total", 2.0, op="gather"),
+        {"name": "rlt_hbm_peak_bytes", "type": "gauge",
+         "labels": {"device": "0"}, "value": 12345},
+        _counter_m("rlt_telemetry_dropped_total", 3),
+    ]))
+    with caplog.at_level(logging.WARNING,
+                         logger="ray_lightning_tpu.telemetry.aggregator"):
+        paths = agg.export()
+    summary = paths["summary"]
+    assert summary["metrics"]["collectives"]["gather"]["gibs"] == \
+        pytest.approx(0.5)
+    assert summary["metrics"]["hbm_peak_bytes"] == {"0": 12345}
+    assert summary["hbm_peak_bytes"] == 12345
+    assert summary["collective_gibs"] == pytest.approx(0.5)
+    # silent data loss is surfaced: summary field + driver warning
+    assert summary["metrics"]["dropped_records"] == {"0": 3}
+    assert any("dropped records" in r.message for r in caplog.records)
+    with open(paths["metrics"]) as f:
+        lines = [json.loads(line) for line in f]
+    assert lines[0]["kind"] == "metrics" and lines[0]["rank"] == 0
+    assert lines[-1]["t"] == "summary"
+
+
+# -- Prometheus exposition + HTTP endpoint ------------------------------
+
+_SERIES_RE = re.compile(
+    r"^[a-z_][a-z0-9_]*(\{[a-zA-Z0-9_=\",.+/ -]*\})? -?[0-9.e+-]+$")
+
+
+def _assert_exposition_parses(text):
+    assert text.endswith("\n")
+    for line in text.rstrip("\n").splitlines():
+        if line.startswith("# TYPE "):
+            assert line.split()[3] in ("counter", "gauge", "histogram")
+            continue
+        assert _SERIES_RE.match(line), f"unparsable series line: {line!r}"
+
+
+def _scraped_aggregator(tmp_path):
+    agg = TelemetryAggregator(str(tmp_path))
+    reg = M.MetricsRegistry(rank=0)
+    reg.counter("rlt_steps_total").inc(4)
+    reg.histogram("rlt_step_time_seconds").observe(0.02)
+    reg.gauge("rlt_hbm_bytes").set(1024, device="0")
+    reg.counter("rlt_collective_bytes_total").inc(4096, op="gather")
+    agg.ingest_metrics(M.metrics_item(0, reg.snapshot()))
+    agg.ingest_metrics(M.metrics_item(1, reg.snapshot()))
+    return agg
+
+
+def test_render_prometheus_format(tmp_path):
+    text = render_prometheus(_scraped_aggregator(tmp_path))
+    _assert_exposition_parses(text)
+    assert '# TYPE rlt_steps_total counter' in text
+    assert 'rlt_steps_total{rank="0"} 4' in text
+    assert 'rlt_steps_total{rank="1"} 4' in text
+    assert 'rlt_hbm_bytes{device="0",rank="0"} 1024' in text
+    assert 'rlt_collective_bytes_total{op="gather",rank="0"} 4096' in text
+    # histogram: cumulative buckets, +Inf terminal, sum/count series
+    assert 'rlt_step_time_seconds_bucket{le="+Inf",rank="0"} 1' in text
+    assert 'rlt_step_time_seconds_count{rank="0"} 1' in text
+
+
+def test_http_server_serves_metrics_and_status(tmp_path):
+    agg = _scraped_aggregator(tmp_path)
+    server = MetricsHTTPServer(agg, port=0)
+    server.start()
+    try:
+        with urllib.request.urlopen(server.url + "/metrics") as r:
+            assert r.status == 200
+            assert "text/plain" in r.headers["Content-Type"]
+            body = r.read().decode()
+        _assert_exposition_parses(body)
+        assert 'rlt_steps_total{rank="1"} 4' in body
+        with urllib.request.urlopen(server.url + "/status") as r:
+            status = json.load(r)
+        assert status["ranks"]["0"]["step"] == 4
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(server.url + "/nope")
+    finally:
+        server.stop()
+
+
+def test_status_merges_heartbeats_and_step_stats(tmp_path):
+    agg = _scraped_aggregator(tmp_path)
+    agg.maybe_ingest(make_heartbeat(0))
+    for i in range(4):
+        agg.ingest_records(0, [{"t": "span", "name": "step",
+                                "ts": 100.0 + i, "dur": 0.010, "rank": 0,
+                                "depth": 0}])
+    status = render_status(agg)
+    r0 = status["ranks"]["0"]
+    assert r0["step"] == 4
+    assert r0["heartbeat_age_s"] >= 0
+    assert r0["step_p50_ms"] == pytest.approx(10.0)
+    assert r0["step_p95_ms"] == pytest.approx(10.0)
+
+
+# -- config / port resolution -------------------------------------------
+
+def test_metrics_port_resolution(monkeypatch):
+    from ray_lightning_tpu.telemetry import TelemetryConfig
+    cfg = TelemetryConfig.resolve(True)
+    assert cfg.metrics and cfg.resolved_metrics_port() is None
+    monkeypatch.setenv("RLT_METRICS_PORT", "9100")
+    assert cfg.resolved_metrics_port() == 9100
+    monkeypatch.setenv("RLT_METRICS_PORT", "nope")
+    assert cfg.resolved_metrics_port() is None
+    assert TelemetryConfig.resolve(
+        {"metrics_port": 0}).resolved_metrics_port() == 0
+
+
+def test_tune_trial_gets_ephemeral_port_and_records_url(tmp_path):
+    """Inside a builtin tune trial an explicit port downgrades to
+    ephemeral (concurrent trials must not fight over one bind) and the
+    bound URL lands on the Trial for ExperimentAnalysis."""
+    from ray_lightning_tpu.telemetry import TelemetryConfig
+    from ray_lightning_tpu.tune.runner import Trial
+    from ray_lightning_tpu.tune.session import TrialSession, set_session
+    from ray_lightning_tpu.telemetry.exporter import start_metrics_server
+    trial = Trial("trial_00000", {}, str(tmp_path / "trial_00000"))
+    set_session(TrialSession(trial, lambda *a: None))
+    try:
+        cfg = TelemetryConfig.resolve({"metrics_port": 9100})
+        server = start_metrics_server(
+            _scraped_aggregator(tmp_path), cfg)
+        assert server is not None
+        try:
+            assert server.port != 9100
+            assert trial.metrics_url == server.url
+        finally:
+            server.stop()
+    finally:
+        set_session(None)
+
+
+# -- trainer integration (in-process) -----------------------------------
+
+def test_local_fit_exports_metrics_jsonl(tmp_path, seed):
+    trainer = Trainer(max_epochs=1, limit_train_batches=4,
+                      limit_val_batches=0, num_sanity_val_steps=0,
+                      enable_checkpointing=False, seed=0,
+                      log_every_n_steps=1, default_root_dir=str(tmp_path),
+                      telemetry={"metrics_interval": 0.1,
+                                 "metrics_port": 0})
+    trainer.fit(BoringModel())
+    paths = trainer._telemetry_paths
+    assert paths["metrics_url"].startswith("http://127.0.0.1:")
+    with open(paths["metrics"]) as f:
+        lines = [json.loads(line) for line in f]
+    final = {}
+    for m in lines[-2]["metrics"]:      # last window before the summary
+        final[(m["name"], tuple(sorted(m["labels"].items())))] = m
+    assert final[("rlt_steps_total", ())]["value"] == 4
+    assert final[("rlt_compiles_total", ())]["value"] == 1
+    hist = final[("rlt_step_time_seconds", ())]
+    assert hist["count"] == 4
+    assert ("rlt_hbm_bytes", (("device", "0"),)) in final
+    assert final[("rlt_data_wait_seconds_total", ())]["value"] > 0
+    # registry torn down after the run
+    assert not M.metrics_enabled()
+
+
+def test_metrics_disabled_leaves_no_stream(tmp_path, seed):
+    trainer = Trainer(max_epochs=1, limit_train_batches=2,
+                      limit_val_batches=0, num_sanity_val_steps=0,
+                      enable_checkpointing=False, seed=0,
+                      default_root_dir=str(tmp_path),
+                      telemetry={"metrics": False})
+    trainer.fit(BoringModel())
+    paths = trainer._telemetry_paths
+    assert "metrics" not in paths and "metrics_url" not in paths
+
+
+# -- end-to-end over the cluster backend --------------------------------
+
+@pytest.mark.slow
+def test_e2e_two_workers_collective_bytes_and_live_scrape(tmp_path, seed):
+    """2-worker ZeRO-1 fit: per-rank metrics windows reach the driver,
+    /metrics is scrapable WHILE the run is live, and the summary carries
+    size-consistent per-op collective bytes + achieved GiB/s."""
+    plugin = cpu_plugin(2, strategy="zero1")
+    scrape = {}
+
+    def scraper():
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            server = getattr(plugin, "_metrics_server", None)
+            if server is None:
+                time.sleep(0.05)
+                continue
+            try:
+                with urllib.request.urlopen(server.url + "/metrics",
+                                            timeout=2) as r:
+                    body = r.read().decode()
+                with urllib.request.urlopen(server.url + "/status",
+                                            timeout=2) as r:
+                    status = json.load(r)
+            except Exception:
+                time.sleep(0.1)
+                continue
+            if 'rlt_steps_total{rank="0"}' in body \
+                    and 'rlt_steps_total{rank="1"}' in body:
+                scrape["metrics"] = body
+                scrape["status"] = status
+                return
+            time.sleep(0.1)
+
+    t = threading.Thread(target=scraper, daemon=True)
+    t.start()
+    module = BoringModel()
+    trainer = Trainer(max_epochs=1, limit_train_batches=4,
+                      limit_val_batches=0, num_sanity_val_steps=0,
+                      enable_checkpointing=False, seed=0,
+                      log_every_n_steps=1, plugins=[plugin],
+                      default_root_dir=str(tmp_path),
+                      telemetry={"heartbeat_interval": 0.5,
+                                 "metrics_interval": 0.2,
+                                 "metrics_port": 0})
+    trainer.fit(module)
+    t.join(timeout=10)
+
+    # -- live scrape landed while workers were still fitting
+    assert "metrics" in scrape, "never scraped both ranks live"
+    _assert_exposition_parses(scrape["metrics"])
+    assert "# TYPE rlt_step_time_seconds histogram" in scrape["metrics"]
+    assert 'rlt_hbm_bytes{device="0",rank="1"}' in scrape["metrics"]
+    assert "rlt_collective_bytes_total" in scrape["metrics"]
+    assert set(scrape["status"]["ranks"]) == {"0", "1"}
+
+    # -- exported window stream + per-op bandwidth summary
+    paths = trainer._telemetry_paths
+    with open(paths["metrics"]) as f:
+        windows = [json.loads(line) for line in f][:-1]
+    assert {w["rank"] for w in windows} == {0, 1}
+    summary = paths["summary"]["metrics"]
+    collectives = summary["collectives"]
+    params_bytes = sum(
+        np.asarray(leaf).nbytes for leaf in jax.tree_util.tree_leaves(
+            module._trained_variables["params"]))
+    # gather: _finalize_fit fetches the params tree once per rank, and
+    # both ranks report the identical global payload
+    per_rank = collectives["gather"]["per_rank"]
+    assert per_rank["0"]["bytes"] == per_rank["1"]["bytes"] == params_bytes
+    assert per_rank["0"]["gibs"] > 0
+    # ZeRO in-step traffic: one params' worth per op per executed step
+    for op in ("grad_reduce_scatter", "param_all_gather"):
+        rank_bytes = collectives[op]["per_rank"]["0"]["bytes"]
+        assert rank_bytes == 4 * params_bytes, op
+        assert collectives[op]["gibs"] > 0
+    assert paths["summary"]["collective_gibs"] > 0
+    assert "hbm_peak_bytes" in paths["summary"]
