@@ -5,14 +5,18 @@ attached (one TPU chip under the driver; CPU elsewhere).
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": "steps/sec", "vs_baseline": N,
    "device_ms": M, "telemetry_jsonl": "<path>",
-   "hbm_peak_bytes": N, "collective_gibs": N}
+   "hbm_peak_bytes": N, "collective_gibs": N,
+   "time_to_first_step_seconds": N, "compile_cache": "hit|miss|off"}
 
 ``telemetry_jsonl`` points at the run's exported span/counter stream
 (telemetry/): BENCH rounds can attribute a regression to a phase
 (step vs data_wait vs compile) straight from the recorded spans.
 ``hbm_peak_bytes`` / ``collective_gibs`` come from the metrics plane
 (telemetry/metrics.py) so rounds track memory and comms regressions
-alongside steps/sec.
+alongside steps/sec.  ``time_to_first_step_seconds`` and
+``compile_cache`` come from the compile plane (compile/): set
+``RLT_COMPILE_CACHE=1`` and run twice to measure the cold→warm startup
+win the persistent compilation cache buys.
 
 ``value`` is wall steps/sec (the BASELINE.md bar as specified);
 ``device_ms`` is the median device time of the compiled train step

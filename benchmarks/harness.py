@@ -120,6 +120,15 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
         "unit": "steps/sec",
         "vs_baseline": round(steps_per_sec / (baseline or steps_per_sec), 3),
     }
+    # cold-vs-warm startup tracking (compile/): how long until the first
+    # step ran, and whether the persistent compilation cache served this
+    # process ("hit"), compiled everything fresh ("miss") or was off —
+    # so BENCH rounds catch startup regressions steps/sec can't see
+    ttfs = getattr(trainer, "time_to_first_step", None)
+    if ttfs is not None:
+        result["time_to_first_step_seconds"] = round(ttfs, 3)
+    from ray_lightning_tpu.compile import cache as compile_cache
+    result["compile_cache"] = compile_cache.status_word()
     paths = getattr(trainer, "_telemetry_paths", None)
     if paths:
         result["telemetry_jsonl"] = paths["jsonl"]

@@ -36,6 +36,12 @@ if [[ "$CHECK" == 1 ]]; then
     # init, and runpy would re-execute it with a RuntimeWarning)
     python -c 'import sys; from ray_lightning_tpu.telemetry.metrics \
         import _main; sys.exit(_main(["--check-names"]))'
+    # compile-plane selfcheck: env knobs round-trip through worker_env,
+    # the cache-seeding pack/unpack round-trips, and every metric the
+    # compile plane publishes is covered by the name lint above
+    # (ray_lightning_tpu/compile/selfcheck.py; no jax backend touched)
+    python -c 'import sys; from ray_lightning_tpu.compile.selfcheck \
+        import _main; sys.exit(_main([]))'
 fi
 
 if [[ "$ALL" == 1 ]]; then

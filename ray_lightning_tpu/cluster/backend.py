@@ -73,6 +73,13 @@ class ClusterBackend:
     #: dereference (fan-out ships the payload once instead of per-worker).
     supports_object_store: bool = False
 
+    #: True when every actor sees the driver's filesystem (same node /
+    #: shared mount).  The compile plane branches on this: shared-FS
+    #: backends point workers at the driver's persistent-compilation-
+    #: cache dir directly; others get a packed seed of it shipped
+    #: through the object store (compile/shipping.py).
+    shared_filesystem: bool = False
+
     def create_actor(
         self,
         actor_cls: type,

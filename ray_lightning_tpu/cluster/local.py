@@ -219,6 +219,10 @@ class RemoteActorError(RuntimeError):
 
 class LocalBackend(ClusterBackend):
     supports_object_store = True  # shm segments, see module docstring
+    # actors are subprocesses on THIS node: the driver's persistent
+    # compilation-cache dir is directly usable by every worker, so the
+    # compile plane shares it via env instead of shipping a seed blob
+    shared_filesystem = True
 
     def __init__(self):
         self._dir = tempfile.mkdtemp(prefix="rlt_cluster_")

@@ -141,6 +141,12 @@ class RayActorHandle(ActorHandle):
 
 class RayBackend(ClusterBackend):
     supports_object_store = True
+    # Ray actors may land on other nodes where the driver's compile-
+    # cache path is an empty local dir — the plugin ships a packed seed
+    # of the driver's cache through ray.put instead (one object, every
+    # worker derefs; compile/shipping.py).  Workers still WRITE to their
+    # node-local dir at the same path, so co-located restarts warm up.
+    shared_filesystem = False
 
     def __init__(self, address: Optional[str] = None):
         """Connect to (or start) a Ray runtime.

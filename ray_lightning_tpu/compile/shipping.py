@@ -1,0 +1,97 @@
+"""Cache-dir shipping for backends without a shared filesystem.
+
+The built-in subprocess backend's workers live on the driver's node, so
+pointing them at the driver's cache ROOT is already sharing
+(``ClusterBackend.shared_filesystem``).  Real Ray workers may land on
+other nodes where the driver's cache path is an empty local dir — for
+those, the plugin packs the driver's cache root into one blob, ships it
+through the object store (once, not per worker), and each worker seeds
+its local dir from the blob before its first compile.  Seeding is
+strictly additive (existing entries are never overwritten) and capped,
+so a huge accumulated cache degrades to partial seeding, not a
+multi-GB broadcast.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import os
+import tarfile
+from typing import Optional
+
+_log = logging.getLogger(__name__)
+
+#: don't broadcast more than this much packed cache to workers; newest
+#: entries win (they're the ones the restarted/new run most likely needs)
+MAX_PACK_BYTES = 256 << 20
+
+
+def pack_cache_dir(root: str,
+                   max_bytes: int = MAX_PACK_BYTES) -> Optional[bytes]:
+    """Gzipped tar of ``root``'s cache entries (newest first, stopping
+    at ``max_bytes`` of file payload).  None when the dir is missing or
+    empty — callers then simply skip seeding."""
+    if not root or not os.path.isdir(root):
+        return None
+    entries: list[tuple[float, str, int]] = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            path = os.path.join(dirpath, fn)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, path, st.st_size))
+    if not entries:
+        return None
+    entries.sort(reverse=True)          # newest first
+    buf = io.BytesIO()
+    packed = 0
+    skipped = 0
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for _mtime, path, size in entries:
+            if packed + size > max_bytes:
+                skipped += 1
+                continue
+            packed += size
+            tar.add(path, arcname=os.path.relpath(path, root))
+    if skipped:
+        _log.warning(
+            "compile-cache pack capped at %d bytes: %d older entr%s "
+            "not shipped to workers", max_bytes, skipped,
+            "y" if skipped == 1 else "ies")
+    return buf.getvalue()
+
+
+def unpack_cache_dir(blob: bytes, root: str) -> int:
+    """Seed ``root`` from a :func:`pack_cache_dir` blob.  Existing
+    entries are kept (a worker's own newer compiles beat the driver's
+    snapshot).  Returns the number of entries written."""
+    os.makedirs(root, exist_ok=True)
+    written = 0
+    with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tar:
+        for member in tar.getmembers():
+            if not member.isfile():
+                continue
+            # refuse path escapes from a hostile/corrupt blob
+            dest = os.path.realpath(os.path.join(root, member.name))
+            if not dest.startswith(os.path.realpath(root) + os.sep):
+                _log.warning("skipping cache entry with unsafe path %r",
+                             member.name)
+                continue
+            if os.path.exists(dest):
+                continue
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            src = tar.extractfile(member)
+            if src is None:
+                continue
+            tmp = f"{dest}.{os.getpid()}.tmp"
+            with open(tmp, "wb") as f:
+                f.write(src.read())
+            os.replace(tmp, dest)       # atomic: readers never see partials
+            written += 1
+    return written
+
+
+__all__ = ["pack_cache_dir", "unpack_cache_dir", "MAX_PACK_BYTES"]

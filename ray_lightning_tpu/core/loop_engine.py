@@ -284,6 +284,11 @@ class CachedSource:
         n = len(loader.dataset)
         global_batch = loader.batch_size * self._n_shards
         self._global_batch = global_batch
+        # kick the cached-step AOT compiles NOW (compile/aot.py): the
+        # repacked shape is fully predictable from dataset/batch sizes,
+        # and the upload below is exactly the work the compile should
+        # hide under.  The engine barriers before the first dispatch.
+        self._submit_precompiles(n)
 
         def repack(flat_dev, perm):
             nb = perm.shape[0] // global_batch
@@ -352,6 +357,46 @@ class CachedSource:
             kw["out_shardings"] = t._stacked_batch_shardings
         self._repack_jit = jax.jit(repack, **kw)
         return True
+
+    def _submit_precompiles(self, n: int) -> None:
+        """Background-compile the cached single/multi-step programs from
+        predicted avals.  The batch count replicates ``_epoch_plan``'s
+        arithmetic WITHOUT calling ``_indices()`` (an extra shuffle draw
+        would shift every later epoch's order); a loader whose index
+        count diverges from ``len(dataset)`` just wastes one background
+        compile and falls back to lazy.  Best-effort by construction."""
+        t = self._trainer
+        pre = getattr(t, "_precompiler", None)
+        if pre is None or not pre.enabled \
+                or t._cached_single_step is None:
+            return
+        try:
+            B = self._loader.batch_size
+            P = self._n_shards
+            per_rank = n if P == 1 else (n + (-n) % P) // P
+            nb = per_rank // B
+            if t.limit_train_batches is not None:
+                nb = min(nb, int(t.limit_train_batches))
+            if nb <= 0:
+                return
+            sample = t._host_cast(self._gather_host(np.arange(1)))
+            ds_abs = jax.tree_util.tree_map(
+                lambda s: jax.ShapeDtypeStruct(
+                    (nb, self._global_batch) + np.asarray(s).shape[1:],
+                    np.asarray(s).dtype),
+                sample)
+            idx_dtype = np.dtype(np.int32)
+            pre.submit("cached_single", t._cached_single_step,
+                       (t._abstract_state, ds_abs,
+                        jax.ShapeDtypeStruct((), idx_dtype)))
+            if t.steps_per_execution > 1 and t._cached_multi_step is not None:
+                pre.submit(
+                    "cached_multi", t._cached_multi_step,
+                    (t._abstract_state, ds_abs,
+                     jax.ShapeDtypeStruct((t.steps_per_execution,),
+                                          idx_dtype)))
+        except Exception:   # noqa: BLE001 - overlap only, never fatal
+            _log.debug("cached-step precompile skipped", exc_info=True)
 
     def _flat_shardings(self, flat, n):
         t = self._trainer

@@ -38,6 +38,13 @@ import numpy as np
 import optax
 from flax import serialization
 
+from ray_lightning_tpu.compile import (
+    AotPrecompiler,
+    CompileCacheConfig,
+    global_batch_abstract,
+    stack_abstract,
+)
+from ray_lightning_tpu.compile import cache as compile_cache
 from ray_lightning_tpu.core.callbacks import Callback, ModelCheckpoint
 from ray_lightning_tpu.core.state import TrainState
 from ray_lightning_tpu.core.steps import (
@@ -60,6 +67,7 @@ _RUNTIME_FIELDS = (
     "_state_shardings", "_abstract_state", "_tx", "_init_fn", "_init_rng",
     "_multi_train_step", "_stacked_batch_shardings",
     "_cache_source", "_cached_multi_step", "_cached_single_step",
+    "_precompiler", "_abstract_batch",
 )
 
 # every spelling (PL 1.x and 2.x) that means "half-precision inputs";
@@ -100,6 +108,7 @@ class Trainer:
         enable_progress_bar: bool = False,   # accepted for API parity
         logger: Any = True,                  # accepted for API parity
         telemetry: Any = None,
+        compile_cache: Any = None,
     ):
         if max_epochs is None and (max_steps is None or max_steps < 0):
             max_epochs = 1000
@@ -151,6 +160,13 @@ class Trainer:
         #: exported artifact paths, set by the execution plugin after a
         #: telemetry-enabled run ({"trace": ..., "jsonl": ..., "summary"})
         self._telemetry_paths: Optional[dict] = None
+        # persistent XLA compilation cache (compile/): None defers to
+        # the RLT_COMPILE_CACHE* env knobs and — inside a builtin tune
+        # trial — the experiment's shared cache dir.  Resolved HERE (the
+        # trainer is constructed inside the trial thread / on the
+        # driver) so the pickled config carries the tune session's dir
+        # into actor workers that have no session of their own.
+        self.compile_cache = CompileCacheConfig.resolve(compile_cache)
         from ray_lightning_tpu.utils.logger import resolve_logger
         self.logger = resolve_logger(logger, self.default_root_dir)
 
@@ -183,6 +199,11 @@ class Trainer:
                        "node_rank": 0}
         self._cache_bytes_hint = None
         self._mesh = None
+        #: seconds from stage entry to the first completed train step
+        #: (compile + init + upload startup cost; bench.py reports it)
+        self.time_to_first_step: Optional[float] = None
+        self._stage_t0: Optional[float] = None
+        self._precompiler: Optional[AotPrecompiler] = None
         self._epoch_metric_acc: dict[str, list] = {}
         self._warned_skip = False
         self._stage = None
@@ -269,6 +290,8 @@ class Trainer:
     def _run_stage(self, module, datamodule, stage: str,
                    ckpt_path: Optional[str] = None):
         self._stage = stage
+        self._stage_t0 = time.monotonic()
+        self.time_to_first_step = None
         self.lightning_module = module
         module.trainer = self
         self.datamodule = datamodule
@@ -286,6 +309,12 @@ class Trainer:
             "local_rank": 0,
             "node_rank": jax.process_index(),
         }
+
+        # persistent XLA compilation cache: activated before the first
+        # jit so every program of this stage (init, train, eval) is a
+        # disk hit when a previous process — an earlier tune trial, a
+        # pre-restart worker, yesterday's run — compiled it (compile/)
+        compile_cache.activate(self.compile_cache)
 
         # data lifecycle (reference: prepare_data per worker, ray_ddp.py:446)
         if datamodule is not None:
@@ -320,8 +349,7 @@ class Trainer:
                                          batch_hint=batch_hint)
         set_current_mesh(self._mesh)  # for mesh-aware ops (ring attention)
         self._cache_bytes_hint = (
-            _cache_bytes_estimate(loaders.get("train"), example_batch,
-                                  self.limit_train_batches)
+            _cache_bytes_estimate(loaders.get("train"), example_batch)
             if stage == "fit" and self.cache_train_dataset else 0)
         # "compile" covers trace construction + jit setup; the first
         # "step" span additionally contains the XLA compile of the train
@@ -553,6 +581,52 @@ class Trainer:
             for s in ("validate", "test")}
         self._predict_step = _ShardedStepCache(build_predict_step(module),
                                                self, strategy)
+        self._submit_precompiles(example_batch)
+
+    def _submit_precompiles(self, example_batch) -> None:
+        """AOT-compile the step programs in the background (compile/):
+        their input avals are fully known here — abstract state from
+        ``eval_shape``, abstract batch from the peeked example — so XLA
+        compilation starts NOW and hides under state init, the
+        rendezvous, the sanity check and the dataset upload instead of
+        serializing at first dispatch.  The compiled artifact reaches
+        dispatch through the persistent cache (the background compile
+        writes the entry; the first dispatch's compile collapses to a
+        disk retrieval), which is why the precompiler only engages when
+        the cache is active (compile/aot.py).  The engine's
+        ``barrier()`` before the first train dispatch keeps a lazy
+        compile from racing a background one; everything here is
+        best-effort (a mispredicted aval logs and falls back to lazy)."""
+        self._precompiler = AotPrecompiler.resolve()
+        ab = global_batch_abstract(self._host_cast(example_batch),
+                                   jax.process_count())
+        self._abstract_batch = ab
+        if self._stage != "fit":
+            # eval/predict stages never dispatch the train programs;
+            # compiling them in the background would be pure waste (the
+            # lazy _ShardedStepCache path still benefits from the
+            # persistent cache across runs)
+            return
+        self._precompiler.submit("train_step", self._train_step,
+                                 (self._abstract_state, ab))
+        if self._multi_train_step is not None:
+            self._precompiler.submit(
+                "multi_step", self._multi_train_step,
+                (self._abstract_state,
+                 stack_abstract(ab, self.steps_per_execution)))
+        # cached-dataset programs submit from CachedSource.build once the
+        # repacked shape is known (core/loop_engine.py).  The validate
+        # step precompiles only when no sanity check will compile it on
+        # the main thread first anyway — and against the TRAIN batch
+        # structure, the common case (same dataset shapes); a divergent
+        # val structure just wastes one background compile.
+        if self.num_sanity_val_steps == 0:
+            try:
+                ev = self._eval_steps["validate"].jitted_for(ab)
+                self._precompiler.submit("eval_step", ev,
+                                         (self._abstract_state, ab))
+            except Exception:       # noqa: BLE001 - overlap only
+                _log.debug("eval-step precompile skipped", exc_info=True)
 
     def _put_batch(self, batch, strategy, stacked: bool = False):
         """Host numpy batch → step input.  Multi-process: each process
@@ -765,6 +839,13 @@ class Trainer:
         dispatch.  Replaces the round-2 trio of divergent loops.
         """
         source = self._train_source(train_loader, strategy)
+        if self._precompiler is not None:
+            # close the overlap window: everything submitted (train /
+            # chunk / cached-step programs) must land in the executable
+            # caches before the first dispatch, or a lazy compile on
+            # this thread would race the background one for the same
+            # program.  Instant from epoch 2 on (nothing pending).
+            self._precompiler.barrier()
         k = self.steps_per_execution
         while not (self.should_stop or self._max_steps_reached()):
             allowed = self._allowed_chunk()
@@ -819,9 +900,11 @@ class Trainer:
             # batch hook without restating the flag gets the
             # conservative default (True) — its new hook body may well
             # read the batch the base class promised to ignore.
-            if "needs_batch" in vars(cb):
-                return vars(cb)["needs_batch"]     # instance: most derived
-            if name in vars(cb):                   # instance-assigned hook
+            # getattr, not vars(): __slots__ callbacks have no __dict__
+            inst = getattr(cb, "__dict__", {})
+            if "needs_batch" in inst:
+                return inst["needs_batch"]         # instance: most derived
+            if name in inst:                       # instance-assigned hook
                 return True                        # outranks any class flag
             mro = type(cb).__mro__
             hook_at = next(
@@ -851,6 +934,7 @@ class Trainer:
             metrics = source.run_one(self, item)
         self.global_step += 1
         _metrics.on_step(time.monotonic() - t0, step=self.global_step)
+        self._note_first_step(metrics)
         self._accumulate_metrics(metrics)
         if self.global_step % self.log_every_n_steps == 0:
             self._publish_metrics(metrics)
@@ -879,6 +963,7 @@ class Trainer:
         self.global_step += len(items)
         _metrics.on_step(time.monotonic() - t0, k=len(items),
                          step=self.global_step)
+        self._note_first_step(metrics)
         self._accumulate_metrics(metrics)
         self._publish_if_crossed(before, jax.tree_util.tree_map(
             lambda a: a[-1], metrics))
@@ -888,6 +973,17 @@ class Trainer:
                     self, module, metrics,
                     items[-1].batch() if want_batch else None,
                     items[-1].batch_idx)
+
+    def _note_first_step(self, metrics) -> None:
+        """Record time-to-first-step once per stage: the startup cost
+        (compile + init + rendezvous + upload) the compile plane exists
+        to shrink.  Blocks on the first step's metrics so the number
+        covers execution, not just async dispatch — one sync, once."""
+        if self.time_to_first_step is not None or self._stage_t0 is None:
+            return
+        jax.block_until_ready(metrics)
+        self.time_to_first_step = time.monotonic() - self._stage_t0
+        compile_cache.note_first_step(self.time_to_first_step)
 
     # -- metrics ---------------------------------------------------------
 
@@ -1279,7 +1375,12 @@ class _ShardedStepCache:
         self._strategy = strategy
         self._cache: dict = {}
 
-    def __call__(self, state, batch):
+    def jitted_for(self, batch):
+        """The jitted step for this batch *structure* (built on first
+        use, cached).  ``batch`` may be concrete or a tree of
+        ``ShapeDtypeStruct`` — the key and the shardings only read
+        treedef + ndim, which lets the AOT precompiler warm the SAME
+        jit object the eval loop later dispatches through."""
         leaves, treedef = jax.tree_util.tree_flatten(batch)
         key = (treedef, tuple(getattr(l, "ndim", 0) for l in leaves))
         jitted = self._cache.get(key)
@@ -1293,24 +1394,39 @@ class _ShardedStepCache:
             else:
                 jitted = jax.jit(self._fn)
             self._cache[key] = jitted
-        return jitted(state, batch)
+        return jitted
+
+    def __call__(self, state, batch):
+        return self.jitted_for(batch)(state, batch)
 
 
-def _cache_bytes_estimate(loader, example_batch, limit) -> "int | None":
+def _cache_bytes_estimate(loader, example_batch) -> "int | None":
     """Upper-bound bytes of the device-resident train cache (per batch ×
     batch count), for the donation heuristic's budget debit.  None when
     the loader has no length (the same loaders the cache itself refuses,
-    core/loop_engine.py) — the caller then donates, the safe default."""
+    core/loop_engine.py) — the caller then donates, the safe default.
+
+    ``limit_train_batches`` deliberately does NOT shrink the debit:
+    ``CachedSource.build()`` uploads the FULL dataset regardless of the
+    limit (the limit trims the epoch plan, not the flat cache).  And a
+    shuffling loader keeps that flat upload resident for the whole fit
+    *alongside* each epoch's repacked view, so its debit doubles
+    (shuffle=False drops the flat copy right after the first repack —
+    the steady-state residency the budget protects is single there).
+    (Advisor r5 medium: the old limit-capped single-copy estimate let
+    donation skip with far less real headroom than computed.)
+    """
     try:
         n = len(loader)
     except TypeError:
         return None
-    if limit is not None:
-        n = min(n, int(limit))
     batch_bytes = sum(
         int(getattr(leaf, "nbytes", 0) or np.asarray(leaf).nbytes)
         for leaf in jax.tree_util.tree_leaves(example_batch))
-    return n * batch_bytes
+    total = n * batch_bytes
+    if getattr(loader, "shuffle", False):
+        total *= 2
+    return total
 
 
 def _peek_first_batch(loader):

@@ -63,7 +63,8 @@ def _configure_worker_jax() -> None:
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 
-def _worker_run(payload: tuple, rank: int, queue) -> Optional[dict]:
+def _worker_run(payload: tuple, rank: int, queue,
+                cache_seed=None) -> Optional[dict]:
     """Runs inside each actor: join the distributed runtime, re-enter the
     trainer loop, package rank-0 results (execute_remote analog,
     ray_ddp.py:428-502)."""
@@ -71,6 +72,18 @@ def _worker_run(payload: tuple, rank: int, queue) -> Optional[dict]:
     import jax
 
     trainer, module, datamodule, stage, ckpt_path = payload
+    if cache_seed is not None:
+        # no shared filesystem with the driver: seed this node's local
+        # compilation-cache dir from the driver's packed snapshot BEFORE
+        # the first compile (compile/shipping.py).  Additive and
+        # best-effort — a failed seed just means cold compiles.
+        try:
+            from ray_lightning_tpu.compile import shipping
+            shipping.unpack_cache_dir(cache_seed,
+                                      trainer.compile_cache.root)
+        except Exception:
+            _log.warning("compile-cache seeding failed; compiling cold",
+                         exc_info=True)
     nproc = int(os.environ.get("RLT_NUM_PROCESSES", "1"))
     if nproc > 1:
         jax.distributed.initialize(
@@ -106,6 +119,9 @@ def _worker_run(payload: tuple, rank: int, queue) -> Optional[dict]:
         "callback_metrics": dict(trainer.callback_metrics),
         "epoch": int(trainer.current_epoch),
         "global_step": int(trainer.global_step),
+        # startup cost as rank 0 saw it (bench.py reports it; the
+        # compile plane's cold/warm A/B is measured on this number)
+        "time_to_first_step": trainer.time_to_first_step,
     }
     if stage == "fit":
         # Weights return in-band as a state stream — PL's temp-file
@@ -263,6 +279,12 @@ class RayXlaPlugin(ExecutionPlugin):
             # record spans once the fit payload arrives (_worker_run)
             base_env["RLT_TELEMETRY"] = "1"
             base_env["RLT_HEARTBEAT_INTERVAL"] = str(cfg.heartbeat_interval)
+        # persistent-compilation-cache knobs: the pickled trainer already
+        # carries the config, but the env keeps worker-side tooling that
+        # consults RLT_COMPILE_CACHE* (e.g. a nested fit) consistent.
+        # Shared-FS backends (builtin subprocess actors) thereby point
+        # every worker at the DRIVER'S cache root — sharing, not seeding.
+        base_env.update(trainer.compile_cache.worker_env())
         # unique per fit: reusing names across fits in one driver process
         # lets a late/stale connection from a previous run race the new
         # worker's attach
@@ -352,6 +374,7 @@ class RayXlaPlugin(ExecutionPlugin):
                      if hasattr(backend, "worker_queue_proxy")
                      else WorkerQueueProxy())
 
+        cache_seed, cache_seed_ref = self._pack_cache_seed(trainer, backend)
         payload = (trainer, module, datamodule, stage, ckpt_path)
         payload_ref = None
         if backend.supports_object_store:
@@ -360,14 +383,36 @@ class RayXlaPlugin(ExecutionPlugin):
 
         try:
             futures = [
-                w.call("execute", _worker_run, payload, i, queue)
+                w.call("execute", _worker_run, payload, i, queue,
+                       cache_seed)
                 for i, w in enumerate(workers)
             ]
             results = process_results(futures, backend)
         finally:
             if payload_ref is not None:
                 backend.free(payload_ref)
+            if cache_seed_ref is not None:
+                backend.free(cache_seed_ref)
         return self._post_dispatch(trainer, module, stage, results)
+
+    @staticmethod
+    def _pack_cache_seed(trainer, backend):
+        """(seed, ref) for compile-cache seeding: a packed snapshot of
+        the driver's cache root for backends whose workers cannot see
+        the driver's filesystem (compile/shipping.py), shipped once via
+        the object store when available.  (None, None) when the cache is
+        off, the backend shares a filesystem, or the root is empty."""
+        cc = trainer.compile_cache
+        if not cc.enabled or getattr(backend, "shared_filesystem", False):
+            return None, None
+        from ray_lightning_tpu.compile import shipping
+        blob = shipping.pack_cache_dir(cc.root)
+        if blob is None:
+            return None, None
+        if backend.supports_object_store:
+            ref = backend.put(blob)
+            return ref, ref
+        return blob, None
 
     def _tpu_partition_envs(self, node_info, ranks, backend) -> dict[int, dict]:
         """Per-worker TPU chip-visibility env for co-located actors
@@ -417,6 +462,7 @@ class RayXlaPlugin(ExecutionPlugin):
         trainer.callback_metrics.update(rank0.get("callback_metrics", {}))
         trainer.current_epoch = rank0.get("epoch", trainer.current_epoch)
         trainer.global_step = rank0.get("global_step", trainer.global_step)
+        trainer.time_to_first_step = rank0.get("time_to_first_step")
         if stage == "fit":
             stream = rank0.get("state_stream")
             if stream is not None:

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import logging
 import re
+import sys
 import threading
 import time
 from typing import Any, Callable, Optional
@@ -62,6 +63,10 @@ STEP_TIME_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 CORE_METRICS = (
     "rlt_steps_total",
     "rlt_compiles_total",
+    "rlt_compile_seconds_total",
+    "rlt_compile_cache_hits_total",
+    "rlt_compile_cache_misses_total",
+    "rlt_time_to_first_step_seconds",
     "rlt_step_time_seconds",
     "rlt_hbm_bytes",
     "rlt_hbm_peak_bytes",
@@ -245,6 +250,12 @@ class MetricsRegistry:
         # span/metric records lost to the ring buffer are data loss the
         # driver must surface (satellite: silent-drop visibility)
         self.gauge("rlt_telemetry_dropped_total").set(spans.dropped())
+        # compile-plane counters (persistent-cache hits/misses + real
+        # backend-compile seconds) mirror in when that module is live;
+        # sys.modules-gated so an unused compile plane costs nothing
+        cc = sys.modules.get("ray_lightning_tpu.compile.cache")
+        if cc is not None:
+            cc.publish_metrics(self)
         with self._lock:
             instruments = list(self._instruments.values())
         out: list[dict] = []
