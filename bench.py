@@ -32,6 +32,7 @@ comparable to each other.  Timing/emission logic lives in
 
 from __future__ import annotations
 
+import os
 import sys
 
 # First recorded values per (platform, config) so vs_baseline always
@@ -72,6 +73,24 @@ def main() -> None:
     run_steps_per_sec(module, metric, warmup=WARMUP_STEPS,
                       timed=TIMED_STEPS, baseline=BASELINES.get(metric),
                       trace_steps=trace_steps, inline_device_ms=True)
+
+    if os.environ.get("RLT_COMM_AB") == "1":
+        # A/B leg: the same config with int8 gradient collectives on the
+        # data axis (comm/) — prints a second JSON line whose ``comm``
+        # field is "int8" so rounds can track the compressed path's
+        # steps/sec next to the fp32 number of record.  Meaningful on a
+        # multi-device data mesh; on one chip the policy is inert and
+        # the leg measures pure overhead (none expected).
+        from ray_lightning_tpu.comm import CommPolicy
+        module_ab = GPTLightningModule(
+            cfg,
+            dataset_size=batch * (WARMUP_STEPS + TIMED_STEPS + trace_steps),
+            batch_size=batch)
+        run_steps_per_sec(
+            module_ab, metric + "_comm_int8", warmup=WARMUP_STEPS,
+            timed=TIMED_STEPS, baseline=BASELINES.get(metric),
+            trainer_kwargs={"comm_policy": CommPolicy(
+                compress="int8", axes=("data",))})
 
 
 if __name__ == "__main__":

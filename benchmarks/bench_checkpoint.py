@@ -1,0 +1,154 @@
+"""Benchmark: checkpoint save + restore wall time and bytes/s
+(VERDICT #7 — make checkpoint stalls a round-over-round number).
+
+Measures BOTH checkpoint paths on a real sharded TrainState:
+
+- **msgpack full-gather** (``Trainer.save_checkpoint`` mechanics):
+  all-gather the state to host, ``flax.serialization`` msgpack blob,
+  one file; restore = read + ``from_state_dict`` + re-shard device_put.
+- **orbax per-shard async** (``ShardedCheckpointer``): every process
+  writes only its own shards; the save figure here includes
+  ``wait()`` (durability) so it is the worst-case stall, not the async
+  happy path; restore re-shards directly into the mesh.
+
+Prints exactly ONE JSON line:
+
+  {"metric": "checkpoint_io", "unit": "seconds", "rows": [
+     {"config": ..., "path": "msgpack|orbax", "state_bytes": N,
+      "save_seconds": S, "save_bytes_per_s": B,
+      "restore_seconds": S2, "restore_bytes_per_s": B2}, ...]}
+
+Defaults to the gpt2-small and gpt2-medium configs (the driver runs
+this on TPU hosts); ``--configs tiny`` keeps CPU smoke runs tractable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def _state_bytes(state) -> int:
+    import jax
+    return sum(
+        int(np.prod(getattr(leaf, "shape", ()), dtype=np.int64))
+        * np.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree_util.tree_leaves(state))
+
+
+def _build_state(config: str, strategy_name: str):
+    import jax
+
+    from ray_lightning_tpu.core.steps import build_init_fn
+    from ray_lightning_tpu.models.gpt import GPTLightningModule
+    from ray_lightning_tpu.parallel.strategy import resolve_strategy
+
+    module = GPTLightningModule(config, dataset_size=2, batch_size=1)
+    module.setup_model()
+    tx = module.configure_optimizers()
+    strat = resolve_strategy(strategy_name)
+    mesh = strat.build_mesh(batch_hint=1)
+    batch = jax.tree_util.tree_map(
+        np.asarray, next(iter(module.train_dataloader())))
+    init_fn = build_init_fn(module, tx)
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0), batch)
+    shardings = strat.state_shardings(mesh, abstract)
+    state = jax.jit(init_fn, out_shardings=shardings)(
+        jax.random.PRNGKey(0), batch)
+    jax.block_until_ready(state)
+    return state, shardings
+
+
+def _bench_msgpack(state, shardings, workdir: str) -> dict:
+    import jax
+    from flax import serialization
+
+    from ray_lightning_tpu.parallel.gather import fetch_tree
+
+    path = os.path.join(workdir, "full.ckpt")
+    t0 = time.monotonic()
+    host_tree = fetch_tree(state)            # TrainState of host arrays
+    payload = serialization.msgpack_serialize(
+        serialization.to_state_dict(host_tree))
+    with open(path, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    save_s = time.monotonic() - t0
+
+    t0 = time.monotonic()
+    with open(path, "rb") as f:
+        blob = f.read()
+    restored = serialization.from_state_dict(
+        host_tree, serialization.msgpack_restore(blob))
+    restored = jax.device_put(restored, shardings)
+    jax.block_until_ready(restored)
+    restore_s = time.monotonic() - t0
+    return {"save_seconds": save_s, "restore_seconds": restore_s,
+            "file_bytes": len(payload)}
+
+
+def _bench_orbax(state, shardings, workdir: str) -> dict:
+    import jax
+
+    from ray_lightning_tpu.utils.checkpoint import (ShardedCheckpointer,
+                                                    abstract_like)
+
+    directory = os.path.join(workdir, "sharded")
+    ckpt = ShardedCheckpointer(directory)
+    t0 = time.monotonic()
+    ckpt.save(0, state, {"bench": True})
+    ckpt.wait()                      # durability, not dispatch
+    save_s = time.monotonic() - t0
+    ckpt.close()
+
+    ckpt = ShardedCheckpointer(directory)
+    t0 = time.monotonic()
+    restored, _meta = ckpt.restore(abstract_like(state, shardings))
+    jax.block_until_ready(restored)
+    restore_s = time.monotonic() - t0
+    ckpt.close()
+    return {"save_seconds": save_s, "restore_seconds": restore_s}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--configs", default="gpt2-small,gpt2-medium",
+                    help="comma-separated model configs (models/gpt.py)")
+    ap.add_argument("--strategy", default="zero1",
+                    help="sharding strategy for the measured state")
+    args = ap.parse_args(argv)
+
+    rows = []
+    for config in [c for c in args.configs.split(",") if c]:
+        state, shardings = _build_state(config, args.strategy)
+        nbytes = _state_bytes(state)
+        with tempfile.TemporaryDirectory(prefix="rlt_ckpt_bench_") as d:
+            for path_name, bench in (("msgpack", _bench_msgpack),
+                                     ("orbax", _bench_orbax)):
+                r = bench(state, shardings, d)
+                rows.append({
+                    "config": config,
+                    "path": path_name,
+                    "state_bytes": nbytes,
+                    "save_seconds": round(r["save_seconds"], 3),
+                    "save_bytes_per_s": int(
+                        nbytes / max(r["save_seconds"], 1e-9)),
+                    "restore_seconds": round(r["restore_seconds"], 3),
+                    "restore_bytes_per_s": int(
+                        nbytes / max(r["restore_seconds"], 1e-9)),
+                })
+        del state
+    print(json.dumps({"metric": "checkpoint_io", "unit": "seconds",
+                      "rows": rows}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

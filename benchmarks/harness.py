@@ -129,6 +129,15 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
         result["time_to_first_step_seconds"] = round(ttfs, 3)
     from ray_lightning_tpu.compile import cache as compile_cache
     result["compile_cache"] = compile_cache.status_word()
+    # comm plane: which dtype the gradient collectives rode ("fp32" =
+    # uncompressed).  _grad_sync is the worker-side resolution (present
+    # after a LocalPlugin fit); distributed drivers fall back to the
+    # policy, which only activates on multi-process meshes.
+    pol = getattr(trainer, "comm_policy", None)
+    sync = getattr(trainer, "_grad_sync", None)
+    active = sync is not None or (
+        pol is not None and pol.enabled and trainer.world_size > 1)
+    result["comm"] = pol.compress if (active and pol is not None) else "fp32"
     paths = getattr(trainer, "_telemetry_paths", None)
     if paths:
         result["telemetry_jsonl"] = paths["jsonl"]

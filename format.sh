@@ -42,6 +42,12 @@ if [[ "$CHECK" == 1 ]]; then
     # (ray_lightning_tpu/compile/selfcheck.py; no jax backend touched)
     python -c 'import sys; from ray_lightning_tpu.compile.selfcheck \
         import _main; sys.exit(_main([]))'
+    # comm-plane selfcheck: the compression policy resolves correctly on
+    # every built-in strategy, RLT_COMM* env knobs round-trip, and the
+    # compressed collectives lower without error on a small virtual CPU
+    # mesh (ray_lightning_tpu/comm/selfcheck.py)
+    python -c 'import sys; from ray_lightning_tpu.comm.selfcheck \
+        import _main; sys.exit(_main([]))'
 fi
 
 if [[ "$ALL" == 1 ]]; then

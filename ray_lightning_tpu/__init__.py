@@ -49,6 +49,7 @@ from ray_lightning_tpu.plugins import (
     RayXlaShardedPlugin,
     RayXlaSpmdPlugin,
 )
+from ray_lightning_tpu.comm import CommPolicy
 
 __version__ = "0.1.0"
 
@@ -69,5 +70,6 @@ __all__ = [
     "RayXlaPlugin",
     "RayXlaShardedPlugin",
     "RayXlaSpmdPlugin",
+    "CommPolicy",
     "__version__",
 ]

@@ -1,0 +1,45 @@
+"""Comm plane: blockwise-quantized cross-replica gradient collectives.
+
+On a multi-host pod the data-parallel gradient sync rides the slow DCN
+link; EQuARX (PAPERS.md) shows an XLA all-reduce executed blockwise in
+low precision recovers most of that bandwidth at negligible quality
+cost.  This package is the userland version of that idea for the
+framework's sharding-annotation strategies:
+
+- :mod:`quant` — blockwise int8 / bf16 quantize–dequantize kernels in
+  pure ``jax.numpy``/``lax`` (per-block scales, optional stochastic
+  rounding) that fuse into the jitted step.
+- :mod:`collectives` — ``compressed_psum`` / ``compressed_reduce_scatter``
+  / ``compressed_all_gather`` built from ``all_to_all`` + ``all_gather``
+  over a named mesh axis in the compressed dtype (summation always
+  accumulates in fp32 — an int8 ``psum`` would wrap), plus
+  :class:`~ray_lightning_tpu.comm.collectives.GradSync`, the object a
+  strategy's ``grad_transform(mesh, policy)`` hands the step builder.
+  Quantization error is carried as an **error-feedback residual** in the
+  optimizer state and re-injected into the next step's gradients.
+- :mod:`policy` — :class:`CommPolicy` (``Trainer(comm_policy=...)`` /
+  ``RLT_COMM*`` env knobs): which mesh axes compress, block size,
+  rounding mode, error feedback, and the ZeRO-1 updated-param
+  all-gather dtype.
+- :mod:`audit` — HLO wire-byte accounting used by the collective audits
+  (tests/test_collective_audit.py) to prove the compressed programs
+  actually move fewer bytes.
+
+Off by default: with the policy unresolved (or no compressible axis on
+the mesh) every strategy's ``grad_transform`` returns ``None`` and the
+train step is byte-identical to the uncompressed build.
+"""
+
+from ray_lightning_tpu.comm.collectives import (  # noqa: F401
+    CommState,
+    GradSync,
+    build_grad_sync,
+    compressed_all_gather,
+    compressed_psum,
+    compressed_reduce_scatter,
+)
+from ray_lightning_tpu.comm.policy import CommPolicy  # noqa: F401
+from ray_lightning_tpu.comm.quant import (  # noqa: F401
+    blockwise_dequantize,
+    blockwise_quantize,
+)

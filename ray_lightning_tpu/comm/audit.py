@@ -1,0 +1,110 @@
+"""HLO collective wire-byte accounting.
+
+The collective audits (tests/test_collective_audit.py) pin op KINDS; to
+prove the compressed programs actually move fewer bytes they also need
+a byte model over the lowered HLO text.  This module parses collective
+op definitions out of ``compiled.as_text()`` and charges each under the
+standard ring-algorithm cost (per-rank bytes on the wire, dropping the
+common (N−1)/N factor so ratios are exact):
+
+==================  =========================================
+op                  wire bytes charged
+==================  =========================================
+all-reduce          2 × bytes(result)   (reduce-scatter + all-gather phases)
+reduce-scatter      N × bytes(result) = bytes(input)
+all-gather          bytes(result)       (each rank receives the full output)
+all-to-all          bytes(result)       (each rank sends/receives one row set)
+collective-permute  bytes(result)       (one neighbor hop)
+==================  =========================================
+
+Async ``-start`` forms count once (their ``-done`` halves and
+get-tuple-element references are not definitions and never match).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Tuple
+
+COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                  "all-to-all", "collective-permute")
+
+_ITEMSIZE = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8,
+}
+
+_WIRE_FACTOR = {
+    "all-reduce": 2.0,
+    "all-gather": 1.0,
+    "reduce-scatter": None,     # input bytes = result × axis size
+    "all-to-all": 1.0,
+    "collective-permute": 1.0,
+}
+
+#: a collective definition: "<name> = <shape-or-tuple> <op>[-start](..."
+_DEF_RE = re.compile(
+    r"= ((?:\([^)]*\))|(?:[a-z0-9]+\[[^\]]*\]\S*)) "
+    r"(" + "|".join(COLLECTIVE_OPS) + r")(-start)?\(")
+
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        d = d.strip()
+        if d:
+            n *= int(d)
+    return n * _ITEMSIZE.get(dtype, 4)
+
+
+def collective_defs(hlo_text: str):
+    """Yield ``(op, dtypes, result_bytes)`` per collective definition.
+
+    ``result_bytes`` sums every array in the definition's result shape;
+    async ``-start`` tuples repeat the operand alongside the result, so
+    their sum is halved to keep start/done and sync forms comparable.
+    """
+    for m in _DEF_RE.finditer(hlo_text):
+        shapes, op, started = m.group(1), m.group(2), m.group(3)
+        parts = _SHAPE_RE.findall(shapes)
+        total = sum(_shape_bytes(dt, dims) for dt, dims in parts)
+        if started and len(parts) >= 2 and len(parts) % 2 == 0:
+            total //= 2
+        yield op, {dt for dt, _ in parts}, total
+
+
+def collective_wire_bytes(hlo_text: str,
+                          axis_size: int = 1) -> Dict[Tuple[str, str], int]:
+    """``(op, dtype) → wire bytes`` over every collective definition in
+    ``hlo_text`` under the ring cost model above.  ``axis_size`` scales
+    reduce-scatter (whose HLO result is the 1/N shard) back to input
+    bytes.  Mixed-dtype tuple collectives are keyed under their widest
+    element type."""
+    out: Dict[Tuple[str, str], int] = {}
+    for op, dtypes, nbytes in collective_defs(hlo_text):
+        factor = _WIRE_FACTOR[op]
+        wire = (nbytes * axis_size if factor is None
+                else int(nbytes * factor))
+        dtype = max(dtypes, key=lambda d: _ITEMSIZE.get(d, 4)) \
+            if dtypes else "f32"
+        key = (op, dtype)
+        out[key] = out.get(key, 0) + wire
+    return out
+
+
+def total_wire_bytes(hlo_text: str, axis_size: int = 1, *,
+                     ops=None, dtypes=None) -> int:
+    """Sum of :func:`collective_wire_bytes`, optionally filtered to the
+    given op kinds and/or element types."""
+    total = 0
+    for (op, dt), b in collective_wire_bytes(hlo_text, axis_size).items():
+        if ops is not None and op not in ops:
+            continue
+        if dtypes is not None and dt not in dtypes:
+            continue
+        total += b
+    return total

@@ -1,0 +1,452 @@
+"""Compressed cross-replica collectives + the strategy→step GradSync.
+
+The framework's gradient sync is normally *implicit*: the partitioner
+lowers the strategy's sharding annotations to an fp32 all-reduce inside
+the backward pass, so there is no call site to compress.  The comm
+plane therefore makes the sync explicit: the step builder wraps the
+gradient computation in a ``shard_map`` region (params replicated,
+batch sharded on the data axes) where each device computes LOCAL
+gradients and this module performs the reduction in the compressed
+dtype:
+
+- :func:`compressed_reduce_scatter` — quantize the local payload,
+  ``all_to_all`` the int8/bf16 rows, dequantize and SUM IN FP32 (an
+  int8 ``psum`` would wrap at rank count 2); each rank ends with its
+  1/N shard of the sum.
+- :func:`compressed_all_gather` — re-quantize the shard, ``all_gather``
+  the compressed rows, dequantize.
+- :func:`compressed_psum` — the pair composed: the classic
+  reduce-scatter + all-gather spelling of a ring all-reduce, with both
+  wire phases compressed.  Per-rank wire bytes ≈ 2·n·itemsize(mode)
+  versus the fp32 ring's 2·n·4 — the ~4x (int8) / 2x (bf16) the HLO
+  audit pins.
+
+Error feedback: the phase-1 local quantization error (``x − dq(q(x))``)
+is returned alongside the result; :class:`GradSync` stores it per-rank
+in the optimizer state (a ``[world, ...]``-stacked leaf sharded on the
+compressed axes) and adds it back into the next step's local gradients,
+so quantization error accumulates into the model as a one-step delay
+instead of a bias (1-bit-Adam/EF-SGD construction).  The phase-2
+re-quantization error is second-order (quantizing already block-scaled
+values) and is not compensated.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_lightning_tpu.comm.policy import CommPolicy
+from ray_lightning_tpu.comm.quant import (
+    compress_cast,
+    decompress_cast,
+    payload_bytes,
+)
+
+_log = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# in-shard_map primitives
+# ---------------------------------------------------------------------------
+
+
+def _axis_arg(axis):
+    """Normalize an axis spec for the lax collectives: bare name for a
+    single axis (the common case; maximally compatible), tuple for a
+    multi-axis product."""
+    if isinstance(axis, str):
+        return axis
+    axis = tuple(axis)
+    return axis[0] if len(axis) == 1 else axis
+
+
+def _pad_rows(x: jax.Array, world: int, block_size: int):
+    """Flatten ``x`` and pad to ``[world, chunk]`` rows with ``chunk`` a
+    multiple of ``block_size`` (zero fill; zero blocks quantize to
+    exact zeros).  Returns (rows, n) with n the true element count."""
+    flat = x.astype(jnp.float32).ravel()
+    n = flat.size
+    chunk = -(-n // world)
+    chunk = -(-chunk // block_size) * block_size
+    flat = jnp.pad(flat, (0, world * chunk - n))
+    return flat.reshape(world, chunk), n
+
+
+def compressed_reduce_scatter(x: jax.Array, axis, world: int, *,
+                              mode: str = "int8", block_size: int = 64,
+                              stochastic: bool = False,
+                              rng: Optional[jax.Array] = None,
+                              with_error: bool = False):
+    """Inside ``shard_map``: reduce-scatter ``x`` (any shape) over
+    ``axis`` in the compressed dtype.  Returns ``(shard, n)`` — this
+    rank's fp32 ``[chunk]`` shard of the SUM and the true element count
+    — plus the local quantization error (shaped like ``x``) when
+    ``with_error``."""
+    axes = _axis_arg(axis)
+    rows, n = _pad_rows(x, world, block_size)
+    q, scale = compress_cast(rows, mode, block_size,
+                             stochastic=stochastic, rng=rng)
+    qt = lax.all_to_all(q, axes, split_axis=0, concat_axis=0, tiled=True)
+    if scale is not None:
+        st = lax.all_to_all(scale, axes, split_axis=0, concat_axis=0,
+                            tiled=True)
+        shard = jnp.sum(decompress_cast(qt, st, mode, block_size), axis=0)
+    else:
+        shard = jnp.sum(qt.astype(jnp.float32), axis=0)
+    if not with_error:
+        return shard, n
+    err_rows = rows - decompress_cast(q, scale, mode, block_size)
+    err = err_rows.ravel()[:n].reshape(x.shape)
+    return shard, n, err
+
+
+def compressed_all_gather(shard: jax.Array, axis, world: int, *,
+                          mode: str = "int8", block_size: int = 64,
+                          stochastic: bool = False,
+                          rng: Optional[jax.Array] = None) -> jax.Array:
+    """Inside ``shard_map``: all-gather a per-rank ``[chunk]`` shard over
+    ``axis`` in the compressed dtype.  Returns the flat fp32
+    ``[world * chunk]`` result (replicated across the axis)."""
+    axes = _axis_arg(axis)
+    q, scale = compress_cast(shard[None], mode, block_size,
+                             stochastic=stochastic, rng=rng)
+    qg = lax.all_gather(q, axes, tiled=True)
+    if scale is not None:
+        sg = lax.all_gather(scale, axes, tiled=True)
+        full = decompress_cast(qg, sg, mode, block_size)
+    else:
+        full = qg.astype(jnp.float32)
+    return full.ravel()
+
+
+def compressed_psum(x: jax.Array, axis, world: int, *,
+                    mode: str = "int8", block_size: int = 64,
+                    mean: bool = False, stochastic: bool = False,
+                    rng: Optional[jax.Array] = None,
+                    with_error: bool = False):
+    """Inside ``shard_map``: all-reduce ``x`` over ``axis`` with both
+    wire phases compressed (reduce-scatter + all-gather).  Returns the
+    reduced array shaped like ``x`` (and the local phase-1 quantization
+    error when ``with_error`` — in SUM units, i.e. NOT divided by
+    ``world`` even under ``mean``, which is what error feedback needs)."""
+    r1 = rng
+    r2 = None
+    if rng is not None:
+        r1, r2 = jax.random.split(rng)
+    out = compressed_reduce_scatter(x, axis, world, mode=mode,
+                                    block_size=block_size,
+                                    stochastic=stochastic, rng=r1,
+                                    with_error=with_error)
+    shard, n = out[0], out[1]
+    if mean:
+        shard = shard / world
+    full = compressed_all_gather(shard, axis, world, mode=mode,
+                                 block_size=block_size,
+                                 stochastic=stochastic, rng=r2)
+    res = full[:n].reshape(x.shape)
+    if with_error:
+        return res, out[2]
+    return res
+
+
+# ---------------------------------------------------------------------------
+# optimizer-state carrier for the error-feedback residual
+# ---------------------------------------------------------------------------
+
+
+class CommState(NamedTuple):
+    """Wraps the real optimizer state with the per-rank error-feedback
+    residual.  ``residual`` leaves are ``[world, *param_shape]`` fp32,
+    sharded on the compressed axes (each rank owns exactly its slice);
+    ``()`` when error feedback is off so the pytree stays leafless."""
+
+    residual: Any
+    inner: Any
+
+
+# ---------------------------------------------------------------------------
+# GradSync: what a strategy's grad_transform hands the step builder
+# ---------------------------------------------------------------------------
+
+
+class GradSync:
+    """Everything the compiled step needs to route its gradient sync
+    through the compressed collectives for one (mesh, policy, strategy)
+    resolution.  Stateless across steps (the residual lives in the
+    optimizer state); safe to rebuild per stage."""
+
+    def __init__(self, mesh, axes: tuple, policy: CommPolicy,
+                 data_axis_names: tuple,
+                 param_gather_spec_fn=None):
+        self.mesh = mesh
+        self.axes = tuple(axes)
+        self.policy = policy
+        self.world = int(np.prod([mesh.shape[a] for a in self.axes]))
+        #: reduction axes the policy left uncompressed (fp32 pmean)
+        self.plain_axes = tuple(
+            a for a in data_axis_names
+            if a in mesh.axis_names and a not in self.axes
+            and mesh.shape[a] > 1)
+        self.data_axis_names = tuple(
+            a for a in data_axis_names if a in mesh.axis_names)
+        self._param_gather_spec_fn = param_gather_spec_fn
+
+    # -- descriptors -----------------------------------------------------
+
+    @property
+    def error_feedback(self) -> bool:
+        return bool(self.policy.error_feedback)
+
+    def describe(self) -> str:
+        """Short tag for bench JSON / logs, e.g. ``int8[data]``."""
+        return f"{self.policy.compress}[{','.join(self.axes)}]"
+
+    def _comm_kw(self) -> dict:
+        return dict(mode=self.policy.compress,
+                    block_size=self.policy.block_size,
+                    stochastic=self.policy.stochastic_rounding)
+
+    # -- residual plumbing (optimizer-state carrier) ---------------------
+
+    def wrap_tx(self, tx):
+        """Wrap ``tx`` so its state is a :class:`CommState` carrying the
+        error-feedback residual.  The wrapper's ``update`` only threads
+        the residual through — the step builder swaps in the new value
+        after the sync (the residual is produced inside the shard_map
+        region, not inside the optimizer)."""
+        import optax
+
+        ef = self.error_feedback
+        world = self.world
+
+        def init(params):
+            residual = ()
+            if ef:
+                residual = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros((world,) + tuple(p.shape),
+                                        jnp.float32), params)
+            return CommState(residual=residual, inner=tx.init(params))
+
+        def update(updates, state, params=None):
+            new_updates, inner = tx.update(updates, state.inner, params)
+            return new_updates, CommState(residual=state.residual,
+                                          inner=inner)
+
+        return optax.GradientTransformation(init, update)
+
+    @staticmethod
+    def residual_of(opt_state):
+        if isinstance(opt_state, CommState):
+            return opt_state.residual
+        return ()
+
+    @staticmethod
+    def with_residual(opt_state, residual):
+        if isinstance(opt_state, CommState):
+            return opt_state._replace(residual=residual)
+        return opt_state
+
+    def fix_opt_shardings(self, opt_shardings, abstract_opt):
+        """The strategy's ``opt_spec`` walked the residual subtree like
+        any other optimizer leaf; its ``[world, ...]`` stacked dim must
+        instead shard on the compressed axes (dim 0), so each rank holds
+        exactly its own error slice."""
+        if not isinstance(abstract_opt, CommState):
+            return opt_shardings
+        res_sh = jax.tree_util.tree_map(
+            lambda _: NamedSharding(
+                self.mesh,
+                P(self.axes if len(self.axes) > 1 else self.axes[0])),
+            abstract_opt.residual)
+        return CommState(residual=res_sh, inner=opt_shardings.inner)
+
+    # -- in-shard_map pieces ---------------------------------------------
+
+    def axis_index(self):
+        """Combined index along the full data-axis product (for rng
+        decorrelation across shards inside the mapped region)."""
+        idx = jnp.zeros((), jnp.int32)
+        for a in self.data_axis_names:
+            idx = idx * self.mesh.shape[a] + lax.axis_index(a)
+        return idx
+
+    def batch_spec(self, ndim: int) -> P:
+        if ndim == 0:
+            return P()
+        axes = self.data_axis_names
+        return P(axes if len(axes) > 1 else axes[0])
+
+    def residual_specs(self, residual) -> Any:
+        spec = P(self.axes if len(self.axes) > 1 else self.axes[0])
+        return jax.tree_util.tree_map(lambda _: spec, residual)
+
+    def pmean(self, tree):
+        """fp32 mean over ALL data axes (loss / logged metrics / float
+        model-state leaves — the tiny payloads that stay uncompressed)."""
+        names = self.axes + self.plain_axes
+
+        def leaf(x):
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+                return lax.pmean(x, names)
+            return x
+        return jax.tree_util.tree_map(leaf, tree)
+
+    def sync(self, grads, residual, rng: Optional[jax.Array] = None):
+        """Inside ``shard_map``: compressed mean-reduction of the local
+        gradient tree.  ``residual`` leaves arrive as this rank's
+        ``[1, *shape]`` slice (or ``()`` with EF off).  Returns
+        ``(synced, new_residual)`` with the residual re-stacked to
+        ``[1, *shape]`` for the sharded out-spec."""
+        ef = self.error_feedback
+        g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+        r_leaves = jax.tree_util.tree_leaves(residual) if ef \
+            else [None] * len(g_leaves)
+        kw = self._comm_kw()
+        keys = [None] * len(g_leaves)
+        if self.policy.stochastic_rounding:
+            if rng is None:
+                raise ValueError("stochastic rounding needs an rng key")
+            keys = list(jax.random.split(rng, len(g_leaves)))
+        synced, new_res = [], []
+        for g, r, k in zip(g_leaves, r_leaves, keys):
+            x = g.astype(jnp.float32)
+            if ef:
+                x = x + r[0]
+            out = compressed_psum(x, self.axes, self.world, mean=True,
+                                  rng=k, with_error=ef, **kw)
+            if ef:
+                res, err = out
+                new_res.append(err[None])
+            else:
+                res = out
+            if self.plain_axes:
+                res = lax.pmean(res, self.plain_axes)
+            synced.append(res.astype(g.dtype))
+        synced_tree = jax.tree_util.tree_unflatten(treedef, synced)
+        residual_tree = (jax.tree_util.tree_unflatten(treedef, new_res)
+                         if ef else ())
+        return synced_tree, residual_tree
+
+    # -- global-view param re-gather (ZeRO-1 satellite path) -------------
+
+    def regather_params(self, params):
+        """Global view (NOT inside shard_map): route the updated params
+        through a quantize→replicate→dequantize sandwich so the
+        partitioner's post-update all-gather carries the compressed
+        dtype.  ``with_sharding_constraint`` pins the update shard-wise
+        (the ZeRO layout) and the replication constraint on the
+        compressed payload forms the low-precision all-gather."""
+        if self._param_gather_spec_fn is None \
+                or self.policy.param_gather == "none":
+            return params
+        mesh = self.mesh
+        mode = self.policy.param_gather
+        bs = self.policy.block_size
+
+        def leaf(path, p):
+            pstr = _path_str(path)
+            spec = self._param_gather_spec_fn(mesh, pstr, p)
+            if not any(e is not None for e in spec):
+                return p      # too small to shard: no gather to compress
+            p_sh = lax.with_sharding_constraint(
+                p, NamedSharding(mesh, spec))
+            rep = NamedSharding(mesh, P())
+            if mode == "bf16":
+                q = lax.with_sharding_constraint(
+                    p_sh.astype(jnp.bfloat16), rep)
+                return q.astype(p.dtype)
+            # int8: blockwise along the last dim when it divides, else a
+            # per-tensor scale (padding a sharded dim inside global view
+            # could cost a reshard — not worth it for odd shapes)
+            if p.shape[-1] % bs == 0:
+                from ray_lightning_tpu.comm.quant import (
+                    blockwise_dequantize, blockwise_quantize)
+                q, scale = blockwise_quantize(
+                    p_sh.astype(jnp.float32), bs)
+                q = lax.with_sharding_constraint(q, rep)
+                scale = lax.with_sharding_constraint(scale, rep)
+                return blockwise_dequantize(q, scale, bs).astype(p.dtype)
+            amax = jnp.max(jnp.abs(p_sh.astype(jnp.float32)))
+            scale = amax / 127.0
+            inv = jnp.where(scale > 0, 1.0 / jnp.where(scale > 0, scale,
+                                                       1.0), 0.0)
+            q = jnp.clip(jnp.round(p_sh.astype(jnp.float32) * inv),
+                         -127, 127).astype(jnp.int8)
+            q = lax.with_sharding_constraint(q, rep)
+            return (q.astype(jnp.float32) * scale).astype(p.dtype)
+
+        return jax.tree_util.tree_map_with_path(leaf, params)
+
+    # -- metrics accounting ----------------------------------------------
+
+    def reduce_scatter_wire_bytes(self, n_elements: int) -> int:
+        return payload_bytes(n_elements, self.policy.compress,
+                             self.policy.block_size)
+
+    def all_gather_wire_bytes(self, n_elements: int) -> int:
+        return payload_bytes(n_elements, self.policy.compress,
+                             self.policy.block_size)
+
+    def psum_wire_bytes(self, n_elements: int) -> int:
+        return (self.reduce_scatter_wire_bytes(n_elements)
+                + self.all_gather_wire_bytes(n_elements))
+
+    def param_gather_wire_bytes(self, abstract_params) -> int:
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(abstract_params):
+            n = int(np.prod(getattr(leaf, "shape", ()), dtype=np.int64))
+            if self.policy.param_gather == "none":
+                total += n * np.dtype(leaf.dtype).itemsize
+            else:
+                total += payload_bytes(n, self.policy.param_gather,
+                                       self.policy.block_size)
+        return total
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        for attr in ("key", "idx", "name"):
+            if hasattr(p, attr):
+                parts.append(str(getattr(p, attr)))
+                break
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def build_grad_sync(strategy, mesh, policy) -> Optional[GradSync]:
+    """Resolve (strategy, mesh, policy) → :class:`GradSync` or ``None``.
+
+    ``None`` (compression inert) when: the policy is off / unresolved,
+    no compressible axis exists on this mesh, the strategy keeps its
+    params sharded across the reduction axes (FSDP/SPMD — the mapped
+    region assumes replicated params), or the mesh carries non-data
+    axes the pure-data-parallel mapped region cannot represent."""
+    policy = CommPolicy.resolve(policy)
+    if not policy.enabled:
+        return None
+    if not getattr(strategy, "comm_compressible", False):
+        _log.debug("comm policy inert: strategy %s does not support "
+                   "compressed gradient collectives", strategy.name)
+        return None
+    extra = set(mesh.axis_names) - set(strategy.data_axis_names)
+    if any(mesh.shape[a] > 1 for a in extra):
+        _log.debug("comm policy inert: mesh has non-data axes %s",
+                   sorted(extra))
+        return None
+    axes = policy.resolved_axes(mesh, strategy.data_axis_names)
+    if not axes:
+        return None
+    spec_fn = None
+    if policy.param_gather != "none":
+        spec_fn = getattr(strategy, "param_gather_spec", None)
+    return GradSync(mesh, axes, policy, strategy.data_axis_names,
+                    param_gather_spec_fn=spec_fn)

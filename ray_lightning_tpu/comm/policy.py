@@ -1,0 +1,153 @@
+"""Per-axis compression policy for the gradient collectives.
+
+``CommPolicy`` decides WHICH named mesh axes carry compressed
+reductions and how.  The default resolution follows the fabric: on a
+multi-process run the ``data`` axis spans hosts (DCN — the slow link
+EQuARX targets) and is compressed; a single-process mesh is all-ICI and
+stays fp32 unless axes are named explicitly (``axes=("data",)`` — which
+is also how the CPU-mesh tests and single-host A/Bs opt in).
+
+Construction paths (first match wins, mirroring TelemetryConfig /
+CompileCacheConfig):
+
+- ``Trainer(comm_policy=CommPolicy(...))`` — full control;
+- ``Trainer(comm_policy="int8")`` — compress with defaults;
+- ``Trainer(comm_policy={...})`` — kwargs dict;
+- ``RLT_COMM=int8`` (+ ``RLT_COMM_AXES=data``, ``RLT_COMM_BLOCK=64``,
+  ``RLT_COMM_SR=1``, ``RLT_COMM_EF=0``, ``RLT_COMM_PARAM_GATHER=bf16``)
+  — env knobs, read when the Trainer arg is ``None``.
+
+The resolved policy is a frozen dataclass that pickles with the trainer
+driver→worker; the env knobs additionally round-trip through
+``worker_env()`` so worker-side tooling (nested fits) stays consistent,
+like the compile plane's knobs do.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+VALID_COMPRESS = ("none", "int8", "bf16")
+VALID_PARAM_GATHER = ("none", "bf16", "int8")
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name, "").strip()
+    if raw in ("0", "false", "False"):
+        return False
+    if raw in ("1", "true", "True"):
+        return True
+    return default
+
+
+@dataclasses.dataclass(frozen=True)
+class CommPolicy:
+    """How cross-replica gradient collectives compress.
+
+    compress: payload dtype of the gradient reduction over the selected
+        axes — ``"int8"`` (blockwise scales, ~4x fewer bytes),
+        ``"bf16"`` (plain cast, 2x), ``"none"`` (off; the default —
+        bit-identical to the uncompressed build).
+    axes: mesh axes whose reduction compresses.  ``None`` = auto:
+        the strategy's data axes when the run spans processes (the
+        DCN case), nothing on a single process (all-ICI stays fp32).
+    block_size: int8 scale-block length.
+    stochastic_rounding: unbiased quantizer (one uniform per element).
+    error_feedback: carry the per-rank quantization error in optimizer
+        state and re-inject it next step (parity-critical; on by
+        default whenever compression is on).
+    param_gather: dtype of ZeRO-1's updated-param all-gather —
+        ``"none"`` keeps it at the parameter dtype (no quality risk),
+        ``"bf16"``/``"int8"`` compress it too (no error feedback exists
+        on the parameter path, so this is the aggressive opt-in).
+    """
+
+    compress: str = "none"
+    axes: Optional[tuple] = None
+    block_size: int = 64
+    stochastic_rounding: bool = False
+    error_feedback: bool = True
+    param_gather: str = "none"
+
+    def __post_init__(self):
+        if self.compress not in VALID_COMPRESS:
+            raise ValueError(
+                f"comm_policy compress {self.compress!r}; "
+                f"options: {VALID_COMPRESS}")
+        if self.param_gather not in VALID_PARAM_GATHER:
+            raise ValueError(
+                f"comm_policy param_gather {self.param_gather!r}; "
+                f"options: {VALID_PARAM_GATHER}")
+        if self.block_size <= 0:
+            raise ValueError("comm_policy block_size must be positive")
+        if self.axes is not None:
+            object.__setattr__(self, "axes", tuple(self.axes))
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def resolve(cls, value) -> "CommPolicy":
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, str):
+            return cls(compress=value)
+        if isinstance(value, dict):
+            return cls(**value)
+        if value is not None:
+            raise TypeError(f"bad comm_policy: {value!r}")
+        compress = os.environ.get("RLT_COMM", "none").strip() or "none"
+        axes_raw = os.environ.get("RLT_COMM_AXES", "").strip()
+        axes = tuple(a for a in axes_raw.split(",") if a) or None
+        return cls(
+            compress=compress,
+            axes=axes,
+            block_size=int(os.environ.get("RLT_COMM_BLOCK", "64")),
+            stochastic_rounding=_env_flag("RLT_COMM_SR", False),
+            error_feedback=_env_flag("RLT_COMM_EF", True),
+            param_gather=os.environ.get(
+                "RLT_COMM_PARAM_GATHER", "none").strip() or "none",
+        )
+
+    # -- queries ---------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self.compress != "none"
+
+    def resolved_axes(self, mesh, data_axis_names) -> tuple:
+        """Which of ``mesh``'s axes this policy compresses: the explicit
+        ``axes`` when given, else (auto) the strategy's data axes only
+        when the run spans processes — a single process has no DCN hop
+        to save.  Only reduction (data) axes with size > 1 qualify."""
+        if not self.enabled:
+            return ()
+        if self.axes is not None:
+            candidates = self.axes
+        else:
+            import jax
+            candidates = (tuple(data_axis_names)
+                          if jax.process_count() > 1 else ())
+        return tuple(a for a in candidates
+                     if a in data_axis_names and a in mesh.axis_names
+                     and mesh.shape[a] > 1)
+
+    # -- env round-trip --------------------------------------------------
+
+    def worker_env(self) -> dict:
+        """Env mapping reproducing this policy via :meth:`resolve` in a
+        worker process (the pickled trainer already carries the policy;
+        the env keeps worker-side nested fits consistent)."""
+        if not self.enabled:
+            return {}
+        env = {
+            "RLT_COMM": self.compress,
+            "RLT_COMM_BLOCK": str(self.block_size),
+            "RLT_COMM_SR": "1" if self.stochastic_rounding else "0",
+            "RLT_COMM_EF": "1" if self.error_feedback else "0",
+            "RLT_COMM_PARAM_GATHER": self.param_gather,
+        }
+        if self.axes is not None:
+            env["RLT_COMM_AXES"] = ",".join(self.axes)
+        return env

@@ -51,13 +51,24 @@ def _split_loss(out) -> tuple[jax.Array, dict]:
 
 
 def build_train_step(module, tx,
-                     accumulate_grad_batches: int = 1) -> Callable:
+                     accumulate_grad_batches: int = 1,
+                     grad_sync=None) -> Callable:
     """(state, batch) -> (state', metrics).
 
     With ``accumulate_grad_batches=k`` the batch's leading dim is split
     into k microbatches folded with ``lax.scan`` (static trip count —
     XLA-friendly control flow, no data-dependent Python), gradients are
     averaged, and one optimizer step is applied.
+
+    ``grad_sync`` (a ``comm.GradSync``, default ``None``) routes the
+    gradient sync through the comm plane's compressed collectives: the
+    gradient computation runs per-device under ``shard_map`` (params
+    replicated, batch sharded on the data axes), local grads reduce via
+    quantized reduce-scatter + all-gather with the error-feedback
+    residual carried in the optimizer state, and the tiny scalars
+    (loss / logged / float model-state) pmean at fp32.  With ``None``
+    the step is byte-identical to the pre-comm-plane build: gradient
+    sync stays the partitioner's implicit fp32 all-reduce.
     """
 
     def grads_of(params, model_state, rng, batch):
@@ -68,6 +79,91 @@ def build_train_step(module, tx,
         (loss, (new_ms, logged)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
         return loss, new_ms, logged, grads
+
+    def compute_grads(params, model_state, step_rng, batch):
+        """Single or k-microbatch-accumulated gradients.  Identical math
+        in global view (grad_sync None) and per-device view (inside the
+        shard_map region, where ``batch`` is the local shard)."""
+        if accumulate_grad_batches <= 1:
+            return grads_of(params, model_state, step_rng, batch)
+        k = accumulate_grad_batches
+
+        def to_micro(x):
+            if getattr(x, "ndim", 0) == 0:
+                return x
+            if x.shape[0] % k:
+                raise ValueError(
+                    f"Batch size {x.shape[0]} must be divisible by "
+                    f"accumulate_grad_batches={k}")
+            return x.reshape((k, x.shape[0] // k) + x.shape[1:])
+
+        micro = jax.tree_util.tree_map(to_micro, batch)
+
+        def body(carry, mb):
+            ms, acc = carry
+            rng_i = (jax.random.fold_in(step_rng, acc["_i"])
+                     if step_rng is not None else None)
+            loss, ms, logged, grads = grads_of(params, ms, rng_i, mb)
+            acc_g = jax.tree_util.tree_map(jnp.add, acc["g"], grads)
+            return (ms, {"g": acc_g, "_i": acc["_i"] + 1}), (loss, logged)
+
+        # accumulate in fp32 regardless of param residency dtype: k
+        # bf16 additions would lose low bits the optimizer needs
+        zero_g = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(
+                p.shape,
+                jnp.float32 if jnp.issubdtype(p.dtype, jnp.floating)
+                else p.dtype),
+            params)
+        (new_ms, acc), (losses, logged_seq) = jax.lax.scan(
+            body, (model_state, {"g": zero_g, "_i": jnp.zeros(
+                (), jnp.int32)}), micro)
+        grads = jax.tree_util.tree_map(lambda g: g / k, acc["g"])
+        loss = losses.mean()
+        logged = jax.tree_util.tree_map(lambda x: x.mean(), logged_seq)
+        return loss, new_ms, logged, grads
+
+    def synced_grads(state: TrainState, step_rng, batch):
+        """Compressed-sync path: local grads + explicit quantized
+        reduction under shard_map (comm plane module docstring)."""
+        from jax.sharding import PartitionSpec as P
+
+        from ray_lightning_tpu.parallel.mesh import shard_map_compat
+
+        residual = grad_sync.residual_of(state.opt_state)
+        comm_key = None
+        if grad_sync.policy.stochastic_rounding:
+            # derived, never consumed: state.rng advances exactly as in
+            # the uncompressed step (the uses_rng contract holds)
+            comm_key = jax.random.fold_in(state.rng, state.step)
+
+        def local_fn(params, model_state, step_rng, comm_key, batch,
+                     residual):
+            if step_rng is not None:
+                # decorrelate dropout/rng streams across data shards (in
+                # global view one stream spans the global batch; here
+                # each shard draws its own)
+                step_rng = jax.random.fold_in(step_rng,
+                                              grad_sync.axis_index())
+            loss, new_ms, logged, grads = compute_grads(
+                params, model_state, step_rng, batch)
+            if comm_key is not None:
+                comm_key = jax.random.fold_in(comm_key,
+                                              grad_sync.axis_index())
+            grads, new_residual = grad_sync.sync(grads, residual,
+                                                 rng=comm_key)
+            loss, logged, new_ms = grad_sync.pmean((loss, logged, new_ms))
+            return loss, new_ms, logged, grads, new_residual
+
+        batch_specs = jax.tree_util.tree_map(
+            lambda x: grad_sync.batch_spec(getattr(x, "ndim", 0)), batch)
+        res_specs = grad_sync.residual_specs(residual)
+        mapped = shard_map_compat(
+            local_fn, grad_sync.mesh,
+            in_specs=(P(), P(), P(), P(), batch_specs, res_specs),
+            out_specs=(P(), P(), P(), P(), res_specs))
+        return mapped(state.params, state.model_state, step_rng,
+                      comm_key, batch, residual)
 
     def step_fn(state: TrainState, batch: Any):
         if getattr(module, "uses_rng", True):
@@ -80,48 +176,20 @@ def build_train_step(module, tx,
             # MLP's device step is ~2/3 rng bookkeeping)
             new_rng, step_rng = state.rng, None
 
-        if accumulate_grad_batches <= 1:
-            loss, new_ms, logged, grads = grads_of(
+        new_residual = None
+        if grad_sync is None:
+            loss, new_ms, logged, grads = compute_grads(
                 state.params, state.model_state, step_rng, batch)
         else:
-            k = accumulate_grad_batches
-
-            def to_micro(x):
-                if getattr(x, "ndim", 0) == 0:
-                    return x
-                if x.shape[0] % k:
-                    raise ValueError(
-                        f"Batch size {x.shape[0]} must be divisible by "
-                        f"accumulate_grad_batches={k}")
-                return x.reshape((k, x.shape[0] // k) + x.shape[1:])
-
-            micro = jax.tree_util.tree_map(to_micro, batch)
-
-            def body(carry, mb):
-                ms, acc = carry
-                rng_i = (jax.random.fold_in(step_rng, acc["_i"])
-                         if step_rng is not None else None)
-                loss, ms, logged, grads = grads_of(state.params, ms, rng_i, mb)
-                acc_g = jax.tree_util.tree_map(jnp.add, acc["g"], grads)
-                return (ms, {"g": acc_g, "_i": acc["_i"] + 1}), (loss, logged)
-
-            # accumulate in fp32 regardless of param residency dtype: k
-            # bf16 additions would lose low bits the optimizer needs
-            zero_g = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(
-                    p.shape,
-                    jnp.float32 if jnp.issubdtype(p.dtype, jnp.floating)
-                    else p.dtype),
-                state.params)
-            (new_ms, acc), (losses, logged_seq) = jax.lax.scan(
-                body, (state.model_state, {"g": zero_g, "_i": jnp.zeros(
-                    (), jnp.int32)}), micro)
-            grads = jax.tree_util.tree_map(lambda g: g / k, acc["g"])
-            loss = losses.mean()
-            logged = jax.tree_util.tree_map(lambda x: x.mean(), logged_seq)
+            loss, new_ms, logged, grads, new_residual = synced_grads(
+                state, step_rng, batch)
 
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        if grad_sync is not None:
+            new_opt = grad_sync.with_residual(new_opt, new_residual)
         new_params = optax.apply_updates(state.params, updates)
+        if grad_sync is not None:
+            new_params = grad_sync.regather_params(new_params)
         metrics = {"loss": loss, **logged}
         new_state = state.replace(
             step=state.step + 1, params=new_params, model_state=new_ms,

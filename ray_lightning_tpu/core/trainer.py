@@ -67,7 +67,7 @@ _RUNTIME_FIELDS = (
     "_state_shardings", "_abstract_state", "_tx", "_init_fn", "_init_rng",
     "_multi_train_step", "_stacked_batch_shardings",
     "_cache_source", "_cached_multi_step", "_cached_single_step",
-    "_precompiler", "_abstract_batch",
+    "_precompiler", "_abstract_batch", "_grad_sync",
 )
 
 # every spelling (PL 1.x and 2.x) that means "half-precision inputs";
@@ -109,6 +109,7 @@ class Trainer:
         logger: Any = True,                  # accepted for API parity
         telemetry: Any = None,
         compile_cache: Any = None,
+        comm_policy: Any = None,
     ):
         if max_epochs is None and (max_steps is None or max_steps < 0):
             max_epochs = 1000
@@ -167,6 +168,13 @@ class Trainer:
         # driver) so the pickled config carries the tune session's dir
         # into actor workers that have no session of their own.
         self.compile_cache = CompileCacheConfig.resolve(compile_cache)
+        # compressed gradient collectives (comm/): blockwise-quantized
+        # cross-replica reductions with error feedback.  None defers to
+        # the RLT_COMM* env knobs; "none" (the default) keeps the train
+        # step bit-identical to a policy-less build.  The frozen policy
+        # pickles driver→worker with the trainer.
+        from ray_lightning_tpu.comm import CommPolicy
+        self.comm_policy = CommPolicy.resolve(comm_policy)
         from ray_lightning_tpu.utils.logger import resolve_logger
         self.logger = resolve_logger(logger, self.default_root_dir)
 
@@ -361,9 +369,11 @@ class Trainer:
             # the gradient/param collectives XLA compiles into the step
             # from the strategy's shardings have no host call site; the
             # strategy declares their per-step byte cost so the metrics
-            # plane can charge it per executed step
+            # plane can charge it per executed step.  An active comm
+            # plane shrinks the declared bytes to the compressed wire
+            # payload, so rlt_collective_* and bench JSON see the savings
             _metrics.note_step_collectives(strategy.step_collective_bytes(
-                self._mesh, self._abstract_state))
+                self._mesh, self._abstract_state, comm=self._grad_sync))
         with span("init"):
             self._init_state(module, example_batch, strategy, ckpt_path)
 
@@ -411,13 +421,17 @@ class Trainer:
 
     # -- compilation -----------------------------------------------------
 
-    def _configure_tx(self, module):
+    def _configure_tx(self, module, grad_sync=None):
         tx = module.configure_optimizers()
         if isinstance(tx, dict):
             tx = tx["optimizer"]
         if self.gradient_clip_val:
             tx = optax.chain(
                 optax.clip_by_global_norm(self.gradient_clip_val), tx)
+        if grad_sync is not None:
+            # outermost wrap: the optimizer state becomes a CommState
+            # carrying the error-feedback residual (comm/collectives.py)
+            tx = grad_sync.wrap_tx(tx)
         return tx
 
     # HBM per chip for device kinds whose runtime reports no
@@ -449,19 +463,30 @@ class Trainer:
         """Donate the TrainState into the step only when memory needs it.
 
         Donation (in-place state update) halves peak state residency —
-        required for the large configs (the 1.3B fit audits assume it) —
-        but it CONSTRAINS XLA's scheduling: the round-5 A/B measured the
+        what lets the large configs fit their budgets — but it
+        CONSTRAINS XLA's scheduling: the round-5 A/B measured the
         identical gpt2-small program at 51.08 ms/step donated vs
         49.35 ms un-donated on v5e, and BERT at 91.59 vs 90.24.  The
         win does NOT extend up the size axis: gpt2-moe-8e (state
         ~3.6 GB, ~22% of v5e HBM) measured 81.85 un-donated vs 80.08
         donated — so auto skips donation only for SMALL states (the
-        measured win region: state ≤ ~10% of the budget, the 0.3/2.5
-        factors below put the v5e cut at ~1.9 GB, between BERT's win
-        and MoE's loss), and donates whenever the budget is unknown
-        (virtual CPU meshes, profiler-less backends) — the conservative
-        default that keeps every fit audit valid.
-        ``RLT_DONATE=1``/``0`` forces either way.
+        measured win region: state ≤ ~10% of the budget, the
+        ``_donation_cutoff`` factors put the v5e cut at ~1.9 GB,
+        between BERT's win and MoE's loss), and donates whenever the
+        budget is unknown (virtual CPU meshes, profiler-less backends).
+
+        NOTE the relationship to the memory-fit audits
+        (tests/test_memory_fit.py): the audits compile their programs
+        with ``donate_argnums=0`` EXPLICITLY — they certify the donated
+        program and are valid whatever this heuristic picks; they do
+        NOT rely on the heuristic donating.  The converse drift — this
+        heuristic skipping donation where the audited budget math
+        assumed the donated (old+new aliased) peak, e.g. the 1.3B
+        ZeRO-1 state (~2.85 GB/device at data=64) under v4's 32 GB —
+        is exactly why the per-config donation decisions are pinned in
+        tests/test_trainer_local.py::test_donation_decision_table: a
+        change to either side must show up against that table, not
+        silently diverge.  ``RLT_DONATE=1``/``0`` forces either way.
         """
         env = os.environ.get("RLT_DONATE", "").strip()
         if env in ("0", "1"):
@@ -492,15 +517,33 @@ class Trainer:
                 if hasattr(sh, "shard_shape") else aval.shape
             state_bytes += int(np.prod(shape, dtype=np.int64)) \
                 * aval.dtype.itemsize
-        # un-donated peak carries old+new state (2x) on top of the
-        # activations/grads the donated program also needs; the 0.3
-        # ceiling both keeps the skip far from any OOM edge and encodes
-        # the MEASURED win boundary (small states win, ~22%-of-HBM
-        # states lose — see docstring)
+        return self._donation_cutoff(state_bytes, limit)
+
+    @staticmethod
+    def _donation_cutoff(state_bytes: int, limit: int) -> bool:
+        """The auto decision given per-device state bytes and the HBM
+        budget: un-donated peak carries old+new state (2x) on top of the
+        activations/grads the donated program also needs; the 0.3
+        ceiling both keeps the skip far from any OOM edge and encodes
+        the MEASURED win boundary (small states win, ~22%-of-HBM states
+        lose — see the _should_donate docstring).  Pinned per config in
+        tests/test_trainer_local.py::test_donation_decision_table."""
         return not (2.5 * state_bytes < 0.3 * limit)
 
     def _build_compiled(self, module, example_batch, strategy):
-        self._tx = self._configure_tx(module)
+        # comm plane: resolve the policy against this strategy/mesh —
+        # None (the overwhelmingly common case) keeps every jit below
+        # identical to a policy-less build
+        self._grad_sync = strategy.grad_transform(self._mesh,
+                                                  self.comm_policy)
+        if self._grad_sync is not None:
+            _log.info("comm plane active: compressed gradient "
+                      "collectives %s (error_feedback=%s, "
+                      "param_gather=%s)",
+                      self._grad_sync.describe(),
+                      self._grad_sync.error_feedback,
+                      self.comm_policy.param_gather)
+        self._tx = self._configure_tx(module, self._grad_sync)
         self._init_fn = build_init_fn(module, self._tx)
         rng = jax.random.PRNGKey(
             int(os.environ.get("RLT_GLOBAL_SEED", "0")) if self.seed is None
@@ -509,6 +552,13 @@ class Trainer:
         abstract = jax.eval_shape(self._init_fn, rng, example_batch)
         self._abstract_state = abstract
         shardings = strategy.state_shardings(self._mesh, abstract)
+        if self._grad_sync is not None:
+            # the error-feedback residual's [world, ...] stacked dim
+            # shards on the compressed axes, not per the strategy's
+            # generic opt_spec walk
+            shardings = shardings.replace(
+                opt_state=self._grad_sync.fix_opt_shardings(
+                    shardings.opt_state, abstract.opt_state))
         self._state_shardings = shardings
         # Batch placement rides the jit call (in_shardings) instead of an
         # explicit per-step device_put: a numpy batch is transferred and
@@ -525,7 +575,8 @@ class Trainer:
             batch_sh = strategy.batch_shardings(self._mesh, example_batch)
             jit_kwargs["in_shardings"] = (shardings, batch_sh)
         step_fn = build_train_step(module, self._tx,
-                                   self.accumulate_grad_batches)
+                                   self.accumulate_grad_batches,
+                                   grad_sync=self._grad_sync)
         self._train_step = jax.jit(step_fn, **jit_kwargs)
         self._multi_train_step = None
         self._stacked_batch_shardings = None

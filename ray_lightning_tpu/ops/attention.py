@@ -85,7 +85,8 @@ def sharded_flash_attention(q, k, v, *, mesh, causal: bool = True,
     collectives are needed — attention mixes only T and D, which stay
     unsharded here (sequence sharding is ring attention's job)."""
     from ray_lightning_tpu.ops.flash_attention import flash_attention
-    from ray_lightning_tpu.parallel.mesh import data_and_tensor_axes
+    from ray_lightning_tpu.parallel.mesh import (data_and_tensor_axes,
+                                                 shard_map_compat)
     from jax.sharding import PartitionSpec as P
 
     dp, tensor = data_and_tensor_axes(mesh)
@@ -95,8 +96,8 @@ def sharded_flash_attention(q, k, v, *, mesh, causal: bool = True,
         return flash_attention(ql, kl, vl, causal=causal, dtype=dtype,
                                **kw)
 
-    fn = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+    fn = shard_map_compat(inner, mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec)
     return fn(q, k, v)
 
 

@@ -82,6 +82,12 @@ class ShardingStrategy:
     axis_names: tuple[str, ...] = ("data",)
     #: axes the batch's leading dim is sharded over
     data_axis_names: tuple[str, ...] = ("data",)
+    #: whether this strategy's gradient sync can route through the comm
+    #: plane's compressed collectives (ray_lightning_tpu/comm/): requires
+    #: params replicated across the reduction axes — true for DDP and
+    #: ZeRO-1, false for param-sharded strategies (FSDP/SPMD), whose
+    #: mapped-region in_specs would misdeclare the param layout
+    comm_compressible: bool = False
 
     def axis_sizes(self, n_devices: int) -> dict[str, int]:
         return {"data": n_devices}
@@ -177,15 +183,39 @@ class ShardingStrategy:
                 * np.dtype(leaf.dtype).itemsize
         return total
 
-    def step_collective_bytes(self, mesh: Mesh, abstract_state) -> dict:
+    @staticmethod
+    def _tree_elements(tree) -> int:
+        import numpy as np
+        return sum(int(np.prod(getattr(leaf, "shape", ()), dtype=np.int64))
+                   for leaf in jax.tree_util.tree_leaves(tree))
+
+    def grad_transform(self, mesh: Mesh, policy):
+        """Resolve a comm policy against this strategy on this mesh: a
+        ``comm.GradSync`` the step builder routes the gradient reduction
+        through, or ``None`` (the default — the uncompressed build,
+        byte-identical to a policy-less trainer).  See
+        ray_lightning_tpu/comm/collectives.py:build_grad_sync for the
+        resolution rules."""
+        if policy is None:
+            return None
+        from ray_lightning_tpu.comm import build_grad_sync
+        return build_grad_sync(self, mesh, policy)
+
+    def step_collective_bytes(self, mesh: Mesh, abstract_state,
+                              comm=None) -> dict:
         """op -> logical payload bytes ONE optimizer step moves through
         the fabric as a consequence of this strategy's sharding
         annotations (XLA compiles the collectives into the step, so the
         metrics plane accounts them from the annotation, not a call
         site).  Pure DDP: one gradient all-reduce the size of the
-        params."""
+        params.  With an active comm plane (``comm`` = the resolved
+        GradSync) the charge is the COMPRESSED wire payload, so
+        ``rlt_collective_*`` and the bench JSON reflect the savings."""
         if self.data_parallel_size(mesh) <= 1:
             return {}
+        if comm is not None:
+            return {"grad_all_reduce": comm.psum_wire_bytes(
+                self._tree_elements(abstract_state.params))}
         return {"grad_all_reduce": self._tree_bytes(abstract_state.params)}
 
     # Strategies are part of the plugin config pickled driver→worker; they
@@ -199,6 +229,7 @@ class DataParallelStrategy(ShardingStrategy):
     """Pure DDP: replicate state, shard batch, XLA psums grads."""
 
     name = "ddp"
+    comm_compressible = True
 
 
 class Zero1Strategy(ShardingStrategy):
@@ -222,6 +253,7 @@ class Zero1Strategy(ShardingStrategy):
     """
 
     name = "zero1"
+    comm_compressible = True
 
     def __init__(self, min_shard_elements: int = 0):
         self.min_shard_elements = min_shard_elements
@@ -231,14 +263,34 @@ class Zero1Strategy(ShardingStrategy):
             return P()
         return _axis_spec(aval.shape, "data", mesh.shape["data"])
 
-    def step_collective_bytes(self, mesh: Mesh, abstract_state) -> dict:
+    def param_gather_spec(self, mesh: Mesh, path: str, aval) -> P:
+        """Shard layout of the post-update params BEFORE their re-gather
+        (mirrors :meth:`opt_spec` — the update is computed where its
+        optimizer shard lives).  The comm plane's compressed param
+        all-gather constrains the updated params to this spec, quantizes
+        shard-wise, and lets the replication constraint form the
+        low-precision gather."""
+        return self.opt_spec(mesh, path, aval)
+
+    def step_collective_bytes(self, mesh: Mesh, abstract_state,
+                              comm=None) -> dict:
         """ZeRO step traffic: grads reduce-scatter into the sharded
         update, updated params all-gather back out — each one params'
         worth of logical payload (whether XLA lowers the pair literally
         or as all-reduce + slice, the bytes on the wire are the OSS
-        story — see class docstring)."""
+        story — see class docstring).  With an active comm plane the
+        grad phases carry the compressed payload (+ their all-gather
+        leg) and the param gather charges at its policy dtype."""
         if self.data_parallel_size(mesh) <= 1:
             return {}
+        if comm is not None:
+            n = self._tree_elements(abstract_state.params)
+            return {
+                "grad_reduce_scatter": comm.reduce_scatter_wire_bytes(n),
+                "grad_all_gather": comm.all_gather_wire_bytes(n),
+                "param_all_gather": comm.param_gather_wire_bytes(
+                    abstract_state.params),
+            }
         params = self._tree_bytes(abstract_state.params)
         return {"grad_reduce_scatter": params,
                 "param_all_gather": params}
@@ -251,6 +303,8 @@ class FullyShardedStrategy(Zero1Strategy):
     nearly free once sharding is declarative."""
 
     name = "fsdp"
+    comm_compressible = False   # params sharded: no replicated-param
+    #                             mapped region (comm plane declines)
 
     def param_spec(self, mesh: Mesh, path: str, aval) -> P:
         if aval.size < max(2, self.min_shard_elements):

@@ -285,6 +285,10 @@ class RayXlaPlugin(ExecutionPlugin):
         # Shared-FS backends (builtin subprocess actors) thereby point
         # every worker at the DRIVER'S cache root — sharing, not seeding.
         base_env.update(trainer.compile_cache.worker_env())
+        # comm-plane knobs ride the same way: the pickled trainer carries
+        # the resolved CommPolicy; the env keeps worker-side tooling that
+        # consults RLT_COMM* (e.g. a nested fit) consistent with it
+        base_env.update(trainer.comm_policy.worker_env())
         # unique per fit: reusing names across fits in one driver process
         # lets a late/stale connection from a previous run race the new
         # worker's attach

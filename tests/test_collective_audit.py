@@ -38,6 +38,9 @@ import jax
 import numpy as np
 import pytest
 
+from ray_lightning_tpu.comm import CommPolicy
+from ray_lightning_tpu.comm.audit import (collective_defs,
+                                          collective_wire_bytes)
 from ray_lightning_tpu.core.steps import build_init_fn, build_train_step
 from ray_lightning_tpu.models.gpt import GPTLightningModule
 from ray_lightning_tpu.parallel.strategy import resolve_strategy
@@ -45,20 +48,33 @@ from ray_lightning_tpu.parallel.strategy import resolve_strategy
 BATCH = 16
 
 
-def _compiled(strategy, **module_kw):
+def _compiled(strategy, comm_policy=None, module=None, **module_kw):
+    """Compile the real train step under ``strategy`` (optionally with
+    an active comm policy, replicating the trainer's wiring: resolved
+    GradSync, wrapped tx, residual shardings fixup)."""
     strat = resolve_strategy(strategy) if isinstance(strategy, str) \
         else strategy
-    module = GPTLightningModule("tiny", dataset_size=4 * BATCH,
-                                batch_size=BATCH, **module_kw)
+    if module is None:
+        module = GPTLightningModule("tiny", dataset_size=4 * BATCH,
+                                    batch_size=BATCH, **module_kw)
     module.setup_model()
     tx = module.configure_optimizers()
     mesh = strat.build_mesh(batch_hint=BATCH)
+    comm = strat.grad_transform(mesh, comm_policy) \
+        if comm_policy is not None else None
+    if comm is not None:
+        tx = comm.wrap_tx(tx)
     batch = jax.tree_util.tree_map(
         np.asarray, next(iter(module.train_dataloader())))
     abstract = jax.eval_shape(build_init_fn(module, tx),
                               jax.random.PRNGKey(0), batch)
     shardings = strat.state_shardings(mesh, abstract)
-    jitted = jax.jit(build_train_step(module, tx), donate_argnums=0,
+    if comm is not None:
+        shardings = shardings.replace(
+            opt_state=comm.fix_opt_shardings(shardings.opt_state,
+                                             abstract.opt_state))
+    jitted = jax.jit(build_train_step(module, tx, grad_sync=comm),
+                     donate_argnums=0,
                      in_shardings=(shardings,
                                    strat.batch_shardings(mesh, batch)),
                      out_shardings=(shardings, None))
@@ -169,3 +185,169 @@ def test_tensor_parallel_psums_forward(programs):
     assert _count(comp.as_text(), "all-reduce") > 0
     assert comp.memory_analysis().argument_size_in_bytes \
         < 0.8 * programs["ddp"]["args"]
+
+
+# ---------------------------------------------------------------------------
+# compressed collectives (comm/): dtype + wire-byte audit
+# ---------------------------------------------------------------------------
+
+INT8_POLICY = CommPolicy(compress="int8", axes=("data",))
+
+
+@pytest.fixture(scope="module")
+def compressed(programs):
+    """The int8-compressed ddp/zero1 programs (one compile each)."""
+    out = {}
+    for name in ("ddp", "zero1"):
+        _mesh, comp = _compiled(name, comm_policy=INT8_POLICY)
+        out[name] = {"text": comp.as_text()}
+    return out
+
+
+def _wire(text):
+    return collective_wire_bytes(text, axis_size=8)
+
+
+def test_compressed_ddp_reduction_bytes(programs, compressed):
+    """With comm=int8 on the data axis, the DDP grad reduction rides
+    s8 all-to-all + all-gather and the program's total collective wire
+    bytes drop >= 3.5x vs the fp32 all-reduce (the acceptance bar; the
+    residue above 4x is the fp32 per-block scales)."""
+    fp = _wire(programs["ddp"]["text"])
+    q = _wire(compressed["ddp"]["text"])
+    assert ("all-to-all", "s8") in q and ("all-gather", "s8") in q, q
+    # the fp32 gradient all-reduce is gone (only epsilon-sized scalar
+    # psums remain: loss/logged means)
+    assert q.get(("all-reduce", "f32"), 0) < 1024
+    ratio = sum(fp.values()) / sum(q.values())
+    assert ratio >= 3.5, (ratio, fp, q)
+
+
+def test_compressed_zero1_grad_phase_bytes(programs, compressed):
+    """ZeRO-1's grad reduce-scatter (+ its all-gather leg) carries >=
+    3.5x fewer bytes compressed.  The updated-param all-gather is
+    unchanged between legs (param_gather="none"), so subtracting the
+    fp32 leg's f32 all-gather isolates the grad phases."""
+    fp = _wire(programs["zero1"]["text"])
+    q = _wire(compressed["zero1"]["text"])
+    assert ("all-to-all", "s8") in q and ("all-gather", "s8") in q, q
+    param_gather_f32 = fp.get(("all-gather", "f32"), 0) \
+        + fp.get(("all-gather", "bf16"), 0)
+    grad_fp = fp[("all-reduce", "f32")]
+    grad_q = sum(q.values()) - param_gather_f32 \
+        - q.get(("all-reduce", "f32"), 0)
+    assert grad_fp / grad_q >= 3.5, (grad_fp, grad_q, fp, q)
+
+
+def test_comm_policy_off_is_bit_identical(programs):
+    """The resolved-but-off policy (compress="none") routes through the
+    comm-aware wiring and must produce the IDENTICAL program text —
+    default behavior is today's build, byte for byte."""
+    _mesh, comp = _compiled("ddp", comm_policy=CommPolicy())
+    assert comp.as_text() == programs["ddp"]["text"]
+
+
+def test_zero1_param_gather_compresses():
+    """param_gather="int8" re-routes the updated-param all-gather
+    through the quantize→replicate sandwich: the s8 all-gather appears
+    and the full-precision param-sized gather disappears (boring model:
+    one [32, 2] dense layer, cheap compile)."""
+    from ray_lightning_tpu.models import BoringModel
+
+    def boring():
+        return BoringModel(batch_size=BATCH)
+
+    _m, comp_fp = _compiled("zero1", module=boring())
+    _m, comp_q = _compiled(
+        "zero1", module=boring(),
+        comm_policy=CommPolicy(compress="int8", axes=("data",),
+                               param_gather="int8"))
+    fp = _wire(comp_fp.as_text())
+    q = _wire(comp_q.as_text())
+    assert all(dt != "s8" for _op, dt in fp), fp
+    assert ("all-gather", "s8") in q
+    # full-precision gather traffic is reduced to scale-sized f32 rows —
+    # strictly smaller than the s8 payload it describes
+    assert q.get(("all-gather", "f32"), 0) < q[("all-gather", "s8")]
+
+
+# ---------------------------------------------------------------------------
+# ring attention + pipeline (VERDICT #5): the other compiled collectives
+# ---------------------------------------------------------------------------
+
+
+def test_ring_attention_collective_permute_bytes():
+    """Ring attention rotates K/V with collective-permute — per hop one
+    LOCAL block of O(T/N · D) bytes, never an all-gather of the full
+    sequence — and its traced byte note matches the schedule model
+    (ring-1 rotations x global K+V)."""
+    from ray_lightning_tpu.parallel.mesh import build_device_mesh
+    from ray_lightning_tpu.parallel.ring import ring_attention
+    from ray_lightning_tpu.telemetry.metrics import (disable_metrics,
+                                                     enable_metrics)
+
+    mesh = build_device_mesh(("data", "sequence"),
+                             {"data": 1, "sequence": 8})
+    ring = 8
+    b, t, h, d = 2, 64, 2, 8
+    aval = jax.ShapeDtypeStruct((b, t, h, d), np.float32)
+    reg = enable_metrics(rank=0, sink=None, pump=False)
+    try:
+        comp = jax.jit(
+            lambda q, k, v: ring_attention(q, k, v, mesh=mesh)).lower(
+                aval, aval, aval).compile()
+        traced = reg.traced_bytes.get("ring")
+    finally:
+        disable_metrics()
+    text = comp.as_text()
+    hop_bytes = b * (t // ring) * h * d * 4      # one f32 K or V block
+    cps = [x for x in collective_defs(text)
+           if x[0] == "collective-permute"]
+    assert len(cps) == 2 * (ring - 1), len(cps)  # K and V per rotation
+    assert all(nbytes == hop_bytes for _op, _dt, nbytes in cps), cps
+    assert _count(text, "all-gather") == 0, (
+        "ring must rotate blocks, not gather the sequence")
+    # schedule model: (ring-1) rotations move the global K+V once each
+    kv_bytes = 2 * (b * t * h * d * 4)
+    assert traced == (ring - 1) * kv_bytes
+
+
+def test_pipeline_collective_permute_matches_microbatch_schedule():
+    """The pipeline's cross-stage transfer is one collective-permute of
+    exactly one microbatch activation block (B_local/M rows), and its
+    traced byte note matches the GPipe schedule: S stages x (M+S-1)
+    time steps x (x_bytes/M) per hop + the final psum broadcast."""
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.parallel.mesh import build_device_mesh
+    from ray_lightning_tpu.parallel.pipeline import pipeline_forward
+    from ray_lightning_tpu.telemetry.metrics import (disable_metrics,
+                                                     enable_metrics)
+
+    mesh = build_device_mesh(("data", "stage"), {"data": 2, "stage": 4})
+    S, M, L, F, B = 4, 2, 4, 8, 16
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p)
+
+    reg = enable_metrics(rank=0, sink=None, pump=False)
+    try:
+        comp = jax.jit(
+            lambda params, x: pipeline_forward(
+                stage_fn, params, x, n_microbatches=M, mesh=mesh)).lower(
+            jax.ShapeDtypeStruct((L, F, F), np.float32),
+            jax.ShapeDtypeStruct((B, F), np.float32)).compile()
+        traced = reg.traced_bytes.get("pipeline")
+    finally:
+        disable_metrics()
+    text = comp.as_text()
+    mb_bytes = (B // 2 // M) * F * 4     # per-data-shard microbatch, f32
+    cps = [x for x in collective_defs(text)
+           if x[0] == "collective-permute"]
+    assert cps, "pipeline lost its cross-stage ppermute"
+    assert all(nbytes == mb_bytes for _op, _dt, nbytes in cps), cps
+    # the last stage's outputs broadcast with a psum (not a ppermute
+    # chain); its payload is the stacked microbatch outputs
+    assert _count(text, "all-reduce") > 0
+    x_bytes = B * F * 4
+    assert traced == S * (M + S - 1) * x_bytes // M + x_bytes
