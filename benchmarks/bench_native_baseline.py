@@ -224,19 +224,30 @@ def native_mnist(platform):
 
     @functools.partial(jax.jit, donate_argnums=0)
     def step(state, batch):
-        params, opt, _ = state
+        params, opt, _, _ = state
         x, y = batch
 
         def loss_fn(p):
             logits = model.apply(p, x)
-            return optax.softmax_cross_entropy_with_integer_labels(
+            loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits, y).mean()
+            # matched work: the framework module logs per-step train
+            # accuracy in-graph (models/boring.py training_step); the
+            # native leg computes the same metric so the mnist device
+            # ratio compares equal programs — the round-5 README's
+            # "remaining 3 µs is the accuracy metric" footnote is now a
+            # measured comparison, not an explained residual
+            import jax.numpy as jnp
+            acc = jnp.mean((jnp.argmax(logits, -1) == y)
+                           .astype(jnp.float32))
+            return loss, acc
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        (loss, acc), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
         updates, opt = tx.update(grads, opt, params)
-        return optax.apply_updates(params, updates), opt, loss
+        return optax.apply_updates(params, updates), opt, loss, acc
 
-    native = _time_native(step, (params, opt, 0.0), batches,
+    native = _time_native(step, (params, opt, 0.0, 0.0), batches,
                           lambda s: float(np.asarray(s[2])), warmup, timed)
     _emit(f"mnist_native_steps_per_sec_{platform}", native)
 

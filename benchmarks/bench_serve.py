@@ -1,0 +1,108 @@
+"""Serving-plane benchmark: continuous-batching throughput + latency.
+
+Stands up a :class:`ray_lightning_tpu.serve.Server` fleet (CPU workers
+by default; ``RLT_SERVE_WORKERS``/``RLT_SERVE_PLATFORM`` override),
+drives a multi-tenant open-loop workload of mixed-length prompts, and
+emits ONE ``serve`` JSON line with the acceptance numbers:
+
+- ``tokens_per_sec``   — generated tokens / wall seconds
+- ``ttft_p50_ms`` / ``ttft_p99_ms`` — time to first token percentiles
+- ``tpot_p50_ms``      — steady decode time per output token
+- ``batch_occupancy``  — mean live-slot fraction per decode step
+- ``compile_cache``    — hit|miss|off (the compiled-once evidence)
+
+    python -m benchmarks.bench_serve [--requests N] [--slots S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--requests", type=int, default=24)
+    parser.add_argument("--slots", type=int, default=8)
+    parser.add_argument("--max-new-tokens", type=int, default=16)
+    parser.add_argument("--config", default="tiny")
+    args = parser.parse_args()
+
+    from ray_lightning_tpu.compile import cache as compile_cache
+    from ray_lightning_tpu.models.gpt import CONFIGS, GPTLightningModule
+    from ray_lightning_tpu.serve import Server
+
+    cfg = CONFIGS[args.config]
+    num_workers = int(os.environ.get("RLT_SERVE_WORKERS", "2"))
+    platform = os.environ.get("RLT_SERVE_PLATFORM", "cpu")
+    buckets = tuple(b for b in (16, 32, 64, 128, 256)
+                    if b <= cfg.block_size) or (cfg.block_size,)
+
+    server = Server(
+        GPTLightningModule(args.config),
+        num_workers=num_workers, platform=platform,
+        buckets=buckets, max_batch_slots=args.slots,
+        max_new_tokens=args.max_new_tokens,
+        default_root_dir=os.environ.get("RLT_SERVE_DIR", "rlt_serve"),
+        compile_cache=None,   # RLT_COMPILE_CACHE* env knobs apply
+        telemetry={"metrics_port": 0},
+    ).start()
+
+    rng = np.random.default_rng(0)
+    tenants = ("alice", "bob", "carol")
+    try:
+        t0 = time.monotonic()
+        reqs = []
+        for i in range(args.requests):
+            n = int(rng.integers(4, min(buckets[-1], 48)))
+            prompt = rng.integers(1, cfg.vocab_size, size=n)
+            reqs.append(server.submit(prompt,
+                                      tenant=tenants[i % len(tenants)]))
+        outs = [r.result(timeout=600) for r in reqs]
+        wall = time.monotonic() - t0
+    finally:
+        stats = server.stats()
+        server.shutdown()
+
+    total_tokens = sum(len(o) for o in outs)
+    ttfts = np.asarray([r.ttft_s for r in reqs]) * 1e3
+    tpots = np.asarray([r.tpot_s for r in reqs
+                        if r.tpot_s is not None]) * 1e3
+    sched = stats["scheduler"]
+    workers = stats.get("workers", [])
+    retraces = (max(sum(w["retraces"].values()) for w in workers)
+                if workers else None)
+    line = {
+        "metric": "serve",
+        "value": round(total_tokens / wall, 2),
+        "unit": "tokens/s",
+        "serve": {
+            "tokens_per_sec": round(total_tokens / wall, 2),
+            "requests": len(reqs),
+            "total_tokens": int(total_tokens),
+            "wall_s": round(wall, 2),
+            "ttft_p50_ms": round(float(np.percentile(ttfts, 50)), 2),
+            "ttft_p99_ms": round(float(np.percentile(ttfts, 99)), 2),
+            "tpot_p50_ms": (round(float(np.percentile(tpots, 50)), 2)
+                            if len(tpots) else None),
+            "batch_occupancy": round(sched["batch_occupancy"], 3),
+            "tenants": len(tenants),
+            "workers": num_workers,
+            "slots": args.slots,
+            "buckets": list(buckets),
+            "retraces_after_warmup": retraces,
+            "compile_cache": compile_cache.status_word(),
+        },
+    }
+    print(json.dumps(line), flush=True)
+    assert sched["completed"] == len(reqs), sched
+    if retraces is not None:
+        assert retraces == 0, f"decode loop retraced: {workers}"
+
+
+if __name__ == "__main__":
+    main()

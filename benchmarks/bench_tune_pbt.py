@@ -21,6 +21,13 @@ What the one JSON line measures, round over round:
   population toward the good lr).
 - ``exploits``: exploit restarts that actually happened (0 would mean
   the PBT path went untested).
+- ``compute_floor_s`` + ``tune_overhead_ratio``: the sweep wall
+  DECOMPOSED.  A standalone fit of one trial's exact workload measures
+  the steady per-step seconds; the floor is
+  ``trials x epochs x batches x measured_step`` — pure training
+  compute, no Tune.  ``wall / floor`` is then the Tune layer's overhead
+  as a TRACKED RATIO, round over round, instead of an absolute wall
+  number that moves with the box (benchmarks/README.md row).
 
     python -m benchmarks.bench_tune_pbt
 
@@ -49,6 +56,39 @@ def main() -> None:
     batch_size = 128 if platform != "cpu" else 16
 
     exploits: list[str] = []
+    trials = 4
+
+    def measured_step_s() -> float:
+        """Steady per-step seconds of ONE trial's exact workload,
+        measured by a standalone fit (no Tune): median over the
+        post-compile steps — the compute-only number the floor is
+        built from."""
+        from ray_lightning_tpu.core.callbacks import Callback
+
+        class StepTimer(Callback):
+            needs_batch = False
+
+            def __init__(self):
+                self.marks = []
+
+            def on_train_batch_end(self, trainer, module, outputs,
+                                   batch, idx):
+                self.marks.append(time.monotonic())
+
+        timer = StepTimer()
+        module = LightningMNISTClassifier(
+            config={"batch_size": batch_size, "lr": 0.05},
+            train_size=batch_size * train_batches)
+        Trainer(max_epochs=2, limit_train_batches=train_batches,
+                limit_val_batches=0, num_sanity_val_steps=0,
+                enable_checkpointing=False, logger=False, seed=0,
+                callbacks=[timer]).fit(module)
+        import numpy as np
+        deltas = np.diff(np.asarray(timer.marks))
+        # skip the compile-bearing first step; median is tunnel-robust
+        return float(np.median(deltas[1:])) if len(deltas) > 1 else 0.0
+
+    step_s = measured_step_s()
 
     def train_fn(config, checkpoint_dir=None):
         module = LightningMNISTClassifier(
@@ -90,6 +130,10 @@ def main() -> None:
     wall = time.monotonic() - t0
 
     best = analysis.get_best_trial("ptl/val_accuracy", "max")
+    # compute-only floor: what the sweep's training steps alone cost —
+    # everything above it is the Tune layer (scheduling, lease churn,
+    # checkpoint serialization, exploit restarts, validation)
+    floor = trials * epochs * train_batches * step_s
     line = {
         "metric": f"tune_pbt_mnist_4trials_wall_s_{platform}",
         "value": round(wall, 2),
@@ -99,9 +143,12 @@ def main() -> None:
         "exploits": len(exploits),
         "trials_terminated": sum(
             t.status == "TERMINATED" for t in analysis.trials),
+        "measured_step_s": round(step_s, 5),
+        "compute_floor_s": round(floor, 2),
+        "tune_overhead_ratio": round(wall / floor, 2) if floor else None,
     }
     print(json.dumps(line), flush=True)
-    assert line["trials_terminated"] == 4, analysis.trials
+    assert line["trials_terminated"] == trials, analysis.trials
 
 
 if __name__ == "__main__":

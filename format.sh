@@ -48,6 +48,13 @@ if [[ "$CHECK" == 1 ]]; then
     # mesh (ray_lightning_tpu/comm/selfcheck.py)
     python -c 'import sys; from ray_lightning_tpu.comm.selfcheck \
         import _main; sys.exit(_main([]))'
+    # serve-plane selfcheck: bucket resolution + padding, scheduler
+    # invariants (slot uniqueness, tenant quota, fair-share progress)
+    # under a simulated multi-tenant run, serve metric names, and the
+    # prefill/decode programs lowering on a CPU mesh
+    # (ray_lightning_tpu/serve/selfcheck.py)
+    python -c 'import sys; from ray_lightning_tpu.serve.selfcheck \
+        import _main; sys.exit(_main([]))'
 fi
 
 if [[ "$ALL" == 1 ]]; then

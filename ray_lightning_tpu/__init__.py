@@ -53,6 +53,16 @@ from ray_lightning_tpu.comm import CommPolicy
 
 __version__ = "0.1.0"
 
+
+def __getattr__(name):
+    # Server imports lazily (PEP 562): the serve plane is driver-side
+    # API surface that fit-only worker subprocesses never touch, and
+    # every actor spawn pays this package's import cost
+    if name == "Server":
+        from ray_lightning_tpu.serve import Server
+        return Server
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 __all__ = [
     "LightningModule",
     "StepContext",
@@ -71,5 +81,6 @@ __all__ = [
     "RayXlaShardedPlugin",
     "RayXlaSpmdPlugin",
     "CommPolicy",
+    "Server",
     "__version__",
 ]

@@ -1,0 +1,204 @@
+"""Opt-in divergent-loader detection (``RLT_DATA_CHECK=1``).
+
+The multi-process data contract (core/loop_engine.py StreamSource): every
+process derives its batch order from the SAME loader state; only the
+shard stride differs.  A loader that violates it — e.g. a per-rank seed,
+or an order-mutating subclass — trains on silently skewed batch pairings
+(rank A's step k meets rank B's step n-1-k) without any crash.  The
+canary in tests/test_plugin_distributed.py used to merely *document*
+that skew; with this module the framework *detects* it:
+
+- **worker side** (:class:`BatchFingerprinter`, created per epoch by the
+  stream source when enabled): for each consumed batch, a cheap crc32
+  fingerprint of the actual batch bytes AND of the batch the contract
+  says this rank should be consuming (reconstructed from the shared base
+  order exactly the way ``DataLoader.shard`` strides it — the same
+  re-derivation the cached source uses).  Both ride the worker→driver
+  queue as marked items.
+- **driver side** (:class:`DataCheckValidator`, installed by the
+  distributed plugin): raises when any rank's actual fingerprint
+  diverges from its contract fingerprint, or when two ranks disagree on
+  the base-order fingerprint for the same epoch (a per-rank-seeded
+  shuffle).  The raise happens in the driver's poll loop
+  (util.process_results), naming rank, epoch and step.
+
+Cost: one extra dataset gather + two crc32 per step, only when the env
+knob is set — a debugging/CI tool, not an always-on tax.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import zlib
+from typing import Any, Optional
+
+import numpy as np
+
+_log = logging.getLogger(__name__)
+
+ENV_DATA_CHECK = "RLT_DATA_CHECK"
+DATA_CHECK_KEY = "__rlt_data_check__"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_DATA_CHECK, "").strip() == "1"
+
+
+def tree_fingerprint(batch: Any) -> int:
+    """crc32 over every leaf's bytes + shape/dtype (order-sensitive:
+    positional skew MUST change the value)."""
+    import jax
+    crc = 0
+    for leaf in jax.tree_util.tree_leaves(batch):
+        a = np.ascontiguousarray(np.asarray(leaf))
+        crc = zlib.crc32(repr((a.shape, str(a.dtype))).encode(), crc)
+        crc = zlib.crc32(a.tobytes(), crc)
+    return crc
+
+
+class BatchFingerprinter:
+    """Worker-side fingerprint relay for one epoch of one loader."""
+
+    def __init__(self, loader, rank: int, epoch: int, send):
+        self._loader = loader
+        self._rank = rank
+        self._epoch = epoch
+        self._send = send
+        # the shared base order, re-derived the way every honest shard's
+        # _indices() strides it (DataLoader._indices / CachedSource
+        # _epoch_plan do the same reconstruction)
+        base = np.asarray(loader.shard(1, 0)._indices())
+        self._base_fp = zlib.crc32(
+            np.ascontiguousarray(base, np.int64).tobytes())
+        P = max(1, getattr(loader, "num_shards", 1))
+        pad = (-len(base)) % P
+        if pad:
+            base = np.concatenate([base, base[:pad]])
+        self._expected_ids = base[getattr(loader, "shard_index", 0)::P]
+
+    @classmethod
+    def maybe_create(cls, loader, rank: int,
+                     epoch: int) -> "Optional[BatchFingerprinter]":
+        """None unless the knob is set, a worker session queue exists,
+        and the loader exposes the needed anatomy (same surface the
+        cached source requires)."""
+        if not enabled():
+            return None
+        try:
+            from ray_lightning_tpu.session import get_session
+            session = get_session()
+        except ValueError:
+            return None
+        ok = all(hasattr(loader, a) for a in
+                 ("shard", "_indices", "dataset", "collate_fn",
+                  "batch_size")) \
+            and hasattr(loader.dataset, "__len__") \
+            and hasattr(loader.dataset, "__getitem__")
+        if not ok:
+            _log.warning("%s=1 needs a ray_lightning_tpu DataLoader over "
+                         "an indexable dataset; got %r — data check "
+                         "skipped.", ENV_DATA_CHECK, type(loader).__name__)
+            return None
+        return cls(loader, rank, epoch, session.put_queue)
+
+    def _expected_batch(self, batch_idx: int):
+        """The batch the contract says this rank consumes at loader
+        position ``batch_idx`` (mirrors DataLoader.__iter__'s gather)."""
+        from ray_lightning_tpu.core.data import ArrayDataset
+        B = self._loader.batch_size
+        ids = self._expected_ids[batch_idx * B:(batch_idx + 1) * B]
+        if len(ids) == 0:
+            return None
+        ds = self._loader.dataset
+        if isinstance(ds, ArrayDataset):
+            return ds.take(np.asarray(ids))
+        return self._loader.collate_fn([ds[int(i)] for i in ids])
+
+    def observe(self, batch_idx: int, batch: Any) -> None:
+        """Fingerprint one consumed batch and relay the check item."""
+        try:
+            expected = self._expected_batch(batch_idx)
+            item = {
+                DATA_CHECK_KEY: 1,
+                "rank": self._rank,
+                "epoch": self._epoch,
+                "step": batch_idx,
+                "fp": tree_fingerprint(batch),
+                "expected_fp": (tree_fingerprint(expected)
+                                if expected is not None else None),
+                "base_fp": self._base_fp,
+            }
+            self._send(item)
+        except Exception:    # the check must never kill a training step
+            _log.warning("data-check fingerprint failed", exc_info=True)
+
+
+class DataCheckValidator:
+    """Driver-side cross-rank validation of relayed fingerprints."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._base: dict[int, dict[int, int]] = {}   # epoch -> rank -> fp
+        self._failure: Optional[str] = None
+        self.checked = 0
+
+    def maybe_ingest(self, item: Any) -> bool:
+        if not (isinstance(item, dict) and item.get(DATA_CHECK_KEY)):
+            return False
+        rank = item.get("rank", -1)
+        epoch = item.get("epoch", 0)
+        step = item.get("step", -1)
+        with self._lock:
+            self.checked += 1
+            if item.get("expected_fp") is not None \
+                    and item["fp"] != item["expected_fp"] \
+                    and self._failure is None:
+                self._failure = (
+                    f"divergent data order detected: rank {rank} consumed "
+                    f"a batch at epoch {epoch} step {step} that does not "
+                    f"match the shared loader order (actual fingerprint "
+                    f"{item['fp']:#x} != contract {item['expected_fp']:#x})"
+                    f" — every process must derive its order from the "
+                    f"same loader state (core/loop_engine.py contract)")
+            ranks = self._base.setdefault(epoch, {})
+            ranks[rank] = item["base_fp"]
+            if len(set(ranks.values())) > 1 and self._failure is None:
+                self._failure = (
+                    f"divergent base order detected at epoch {epoch}: "
+                    f"ranks disagree on the pre-shard index order "
+                    f"({ {r: hex(f) for r, f in ranks.items()} }) — "
+                    f"per-rank seeds/shuffles violate the shared-loader "
+                    f"contract")
+        return True
+
+    def verify(self) -> None:
+        """Raise on any recorded divergence (called from the driver's
+        poll loop, util.process_results)."""
+        if self._failure is not None:
+            raise RuntimeError(self._failure)
+
+
+_validator: Optional[DataCheckValidator] = None
+
+
+def set_active_validator(v: Optional[DataCheckValidator]) -> None:
+    global _validator
+    _validator = v
+
+
+def get_active_validator() -> Optional[DataCheckValidator]:
+    return _validator
+
+
+__all__ = [
+    "ENV_DATA_CHECK",
+    "DATA_CHECK_KEY",
+    "enabled",
+    "tree_fingerprint",
+    "BatchFingerprinter",
+    "DataCheckValidator",
+    "set_active_validator",
+    "get_active_validator",
+]

@@ -113,6 +113,15 @@ class StreamSource:
         self._prefetch = (trainer.steps_per_execution == 1
                           and os.environ.get("RLT_STREAM_PREFETCH",
                                              "1") != "0")
+        self._fingerprinter = None
+        if trainer.world_size > 1:
+            # opt-in divergent-loader detection (RLT_DATA_CHECK=1):
+            # relay a per-step batch fingerprint to the driver, which
+            # cross-checks ranks against the shared-loader contract and
+            # raises on divergence (core/datacheck.py)
+            from ray_lightning_tpu.core import datacheck
+            self._fingerprinter = datacheck.BatchFingerprinter.maybe_create(
+                loader, trainer.global_rank, trainer.current_epoch)
         self.exhausted = False
 
     def _pull(self) -> "Item | None":
@@ -137,6 +146,8 @@ class StreamSource:
                         self.exhausted = True
                         return None
                     if t._batch_ok(batch, self._strategy):
+                        if self._fingerprinter is not None:
+                            self._fingerprinter.observe(batch_idx, batch)
                         return Item(batch_idx=batch_idx, kind="host",
                                     payload=batch)
             return None

@@ -213,6 +213,17 @@ class LightningModule:
     def configure_optimizers(self):
         raise NotImplementedError
 
+    def configure_decode_model(self):
+        """Serve-plane hook (ray_lightning_tpu/serve/): a flax module for
+        the KV-cache generation path sharing this module's param tree.
+        The module must accept the training forward's ``__call__`` (used
+        for prefill, K/V captured via the ``kv_cache`` sow collection)
+        and expose a ``decode(tokens, positions, k_caches, v_caches)``
+        method (see models/gpt.py GPT.decode).  Default: the training
+        model — override to strip training-only wrappers (remat,
+        dropout) the way GPTLightningModule does."""
+        return self.configure_model()
+
     def setup_model(self) -> None:
         """Materialize ``self.model`` (idempotent; called on each process)."""
         if self.model is None:

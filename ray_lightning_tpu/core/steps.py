@@ -199,6 +199,96 @@ def build_train_step(module, tx,
     return step_fn
 
 
+def build_prefill_step(module, bucket_len: int) -> Callable:
+    """Serve-plane prefill program for ONE sequence-length bucket
+    (sibling of :func:`build_train_step`; consumed by serve/engine.py).
+
+    ``(params, k_caches, v_caches, tokens, slot, length) ->
+    (k', v', first_token)`` where ``tokens`` is ``[1, bucket_len]``
+    (right-padded), ``slot``/``length`` are traced int32 scalars — ONE
+    compiled program per (bucket, topology), whatever slot or true
+    length a request lands on.  The forward is the module's decode model
+    applied normally with the ``kv_cache`` collection mutable, so the
+    captured per-layer K/V are numerically THE training forward's;
+    positions ``>= length`` hold pad garbage the causal mask keeps out
+    of the first token's logits and :func:`cached_attention`'s position
+    bound keeps out of every later one.
+    """
+    module.setup_model()
+    model = module.configure_decode_model()
+
+    def step_fn(params, k_caches, v_caches, tokens, slot, length):
+        logits, captured = model.apply({"params": params}, tokens, True,
+                                       mutable=["kv_cache"])
+        first = jnp.argmax(
+            jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0,
+                                         keepdims=False),
+            axis=-1).astype(tokens.dtype)
+        # captured K/V ride the module tree ({'h0': {'attn': {'kv':
+        # ((k, v),)}}}); stack to [n_layer, 1, Tb, H, D] and write every
+        # layer's block with one dynamic_update_slice at the slot
+        ks, vs = _stacked_kv(captured["kv_cache"])
+        k_caches = jax.lax.dynamic_update_slice(
+            k_caches, ks, (0, slot) + (0,) * (k_caches.ndim - 2))
+        v_caches = jax.lax.dynamic_update_slice(
+            v_caches, vs, (0, slot) + (0,) * (v_caches.ndim - 2))
+        return k_caches, v_caches, first
+
+    return step_fn
+
+
+def kv_layer_pairs(kv_tree) -> "list[tuple]":
+    """Per-layer ``(k, v)`` pairs from the sown ``kv_cache`` collection,
+    in layer order (sorted on the numeric suffix of the flax block
+    names h0, h1, ...).  Works on concrete arrays AND on ``eval_shape``
+    avals (serve/engine.py derives the cache geometry from the latter).
+    """
+    def layer_no(name):
+        digits = "".join(ch for ch in name if ch.isdigit())
+        return int(digits) if digits else 0
+
+    pairs = []
+    for name in sorted(kv_tree, key=layer_no):
+        sub = kv_tree[name]
+        while isinstance(sub, dict):
+            sub = next(iter(sub.values()))
+        pairs.append(sub[0] if isinstance(sub, tuple) and len(sub) == 1
+                     and isinstance(sub[0], tuple) else sub)
+    return pairs
+
+
+def _stacked_kv(kv_tree):
+    """[n_layer, B, Tb, H, D] k/v stacks from the sown collection."""
+    pairs = kv_layer_pairs(kv_tree)
+    ks = jnp.stack([k for k, _ in pairs])
+    vs = jnp.stack([v for _, v in pairs])
+    return ks, vs
+
+
+def build_decode_step(module) -> Callable:
+    """Serve-plane continuous-batching decode program (sibling of
+    :func:`build_train_step`; THE serving hot path).
+
+    ``(params, k_caches, v_caches, tokens, positions) ->
+    (k', v', next_tokens)``: advances EVERY batch slot one token in one
+    compiled SPMD program — ``tokens``/``positions`` are ``[S]``, the
+    caches ``[n_layer, S, L, H, D]``.  Static shapes by construction:
+    request insertion/eviction is a slot-index change in the host-side
+    scheduler, so decode never re-traces (serve/scheduler.py).
+    """
+    module.setup_model()
+    model = module.configure_decode_model()
+
+    def step_fn(params, k_caches, v_caches, tokens, positions):
+        logits, new_k, new_v = model.apply(
+            {"params": params}, tokens, positions, k_caches, v_caches,
+            method="decode")
+        return new_k, new_v, jnp.argmax(logits, axis=-1).astype(
+            tokens.dtype)
+
+    return step_fn
+
+
 def build_eval_step(module, stage: str) -> Callable:
     """(state, batch) -> logged metrics dict (pure, no state mutation)."""
     step = {"validate": module.validation_step,

@@ -476,15 +476,17 @@ class Trainer:
         budget is unknown (virtual CPU meshes, profiler-less backends).
 
         NOTE the relationship to the memory-fit audits
-        (tests/test_memory_fit.py): the audits compile their programs
-        with ``donate_argnums=0`` EXPLICITLY — they certify the donated
-        program and are valid whatever this heuristic picks; they do
-        NOT rely on the heuristic donating.  The converse drift — this
-        heuristic skipping donation where the audited budget math
-        assumed the donated (old+new aliased) peak, e.g. the 1.3B
-        ZeRO-1 state (~2.85 GB/device at data=64) under v4's 32 GB —
-        is exactly why the per-config donation decisions are pinned in
-        tests/test_trainer_local.py::test_donation_decision_table: a
+        (tests/test_memory_fit.py): the donated-program audits compile
+        with ``donate_argnums=0`` EXPLICITLY and are valid whatever
+        this heuristic picks; the SKIP region is audited separately —
+        the un-donated 1.3B ZeRO-1 program (the config this heuristic
+        actually skips on v4-64, state ~2.85 GB/device at data=64) is
+        budget-checked against v4's 32 GB with its extra un-aliased
+        state copy accounted
+        (test_undonated_zero1_budget_in_v4_skip_region and the slow
+        compile-audit leg).  The per-config donation decisions are
+        additionally pinned in
+        tests/test_trainer_local.py::test_donation_decision_table, so a
         change to either side must show up against that table, not
         silently diverge.  ``RLT_DONATE=1``/``0`` forces either way.
         """

@@ -142,13 +142,24 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, deterministic: bool = True):
+    def __call__(self, x, deterministic: bool = True, *,
+                 decode_cache=None, positions=None):
         cfg = self.config
-        x = x + MultiHeadAttention(
+        attn = MultiHeadAttention(
             n_head=cfg.n_head, causal=True, dropout=cfg.dropout,
             dtype=cfg.dtype, attention_impl=cfg.attention_impl,
-            name="attn")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x), deterministic)
+            name="attn")
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
+        new_cache = None
+        if decode_cache is not None:
+            # serve-plane decode: the attention returns the updated slot
+            # cache alongside its output (ops/attention.py)
+            a, new_cache = attn(h, deterministic,
+                                decode_cache=decode_cache,
+                                positions=positions)
+            x = x + a
+        else:
+            x = x + attn(h, deterministic)
         if self.use_moe:
             from ray_lightning_tpu.ops.moe import MoEMLP
             ffn = MoEMLP(n_experts=cfg.n_experts, d_ff=4 * cfg.n_embd,
@@ -159,7 +170,7 @@ class Block(nn.Module):
             ffn = MLP(cfg, name="mlp")
         x = x + ffn(nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x),
                     deterministic)
-        return x
+        return x if new_cache is None else (x, new_cache)
 
 
 def _remat_policy(name: str):
@@ -243,6 +254,37 @@ class GPT(nn.Module):
         # upcast to fp32 only for the loss softmax.
         return self.wte.attend(x).astype(jnp.float32)
 
+    def decode(self, tokens, positions, k_caches, v_caches):
+        """One continuous-batching decode step over ``S`` batch slots
+        (the serve plane's hot program, ray_lightning_tpu/serve/).
+
+        ``tokens`` [S] int32 — each slot's current token; ``positions``
+        [S] int32 — that token's absolute position; ``k_caches`` /
+        ``v_caches`` [n_layer, S, L, H, D] — the slot-indexed KV cache.
+        Writes each token's K/V at its slot position and returns
+        ``(logits [S, V] fp32, new_k, new_v)``.  Traces with STATIC
+        shapes regardless of which slots are live — in-flight request
+        insertion/eviction happens by slot index, never by re-trace.
+
+        Use through ``configure_decode_model()`` (remat/dropout off);
+        MoE configs are rejected by the serve engine (token routing is
+        batch-shaped, unsupported in the decode path).
+        """
+        cfg = self.config
+        x = self.wte(tokens[:, None])
+        x = x + jnp.take(self.wpe, positions, axis=0)[:, None, :].astype(
+            cfg.dtype)
+        new_k, new_v = [], []
+        for i, blk in enumerate(self.blocks):
+            x, (k, v) = blk(x, True,
+                            decode_cache=(k_caches[i], v_caches[i]),
+                            positions=positions)
+            new_k.append(k)
+            new_v.append(v)
+        x = self.ln_f(x)
+        logits = self.wte.attend(x).astype(jnp.float32)
+        return logits[:, 0], jnp.stack(new_k), jnp.stack(new_v)
+
 
 def gpt_partition_rules(tensor_axis: str = "tensor") -> list[tuple[str, P]]:
     """SpmdStrategy rules for a (data, [fsdp,] tensor) mesh.
@@ -304,6 +346,19 @@ class GPTLightningModule(LightningModule):
 
     def configure_model(self):
         return GPT(self.config)
+
+    def configure_decode_model(self):
+        """Serve-plane model (serve/engine.py): the SAME param tree as
+        the training model — remat off (no backward pass to save memory
+        for; kwargs-through-remat is also fragile) and dropout off
+        (generation is deterministic)."""
+        if self.config.n_experts > 0:
+            raise ValueError(
+                "serve decode does not support MoE configs: expert "
+                "routing is batch-shaped and has no single-token cache "
+                "path yet (models/gpt.py GPT.decode)")
+        return GPT(dataclasses.replace(self.config, remat=False,
+                                       dropout=0.0))
 
     @property
     def param_dtype(self):

@@ -113,6 +113,29 @@ def local_attention(q, k, v, **kw):
     return dot_product_attention(q, k, v, **kw)
 
 
+def cached_attention(q, k_cache, v_cache, positions, *,
+                     dtype=jnp.bfloat16):
+    """Single-token attention against a slot-indexed KV cache (the serve
+    plane's decode core, ray_lightning_tpu/serve/).
+
+    ``q``: [S, 1, H, D] — one new token per batch slot; ``k_cache`` /
+    ``v_cache``: [S, L, H, D] — each slot's full context; ``positions``:
+    [S] — the absolute position of slot s's current token.  Slot s
+    attends cache indices <= positions[s]: indices beyond its position
+    hold stale prefill padding or a previous tenant's leftovers, which
+    decode must never read (serve/kvcache.py invariant).
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("sqhd,slhd->shql", q, k_cache,
+                        preferred_element_type=jnp.float32)
+    scores = scores / np.sqrt(d)
+    valid = jnp.arange(k_cache.shape[1])[None, :] <= positions[:, None]
+    scores = jnp.where(valid[:, None, None, :], scores,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    return jnp.einsum("shql,slhd->sqhd", probs, v_cache)
+
+
 def resolve_attention(impl: str) -> Callable:
     if impl == "auto":
         return auto_attention
@@ -144,13 +167,36 @@ class MultiHeadAttention(nn.Module):
     attention_impl: str = "auto"
 
     @nn.compact
-    def __call__(self, x, deterministic: bool = True):
+    def __call__(self, x, deterministic: bool = True, *,
+                 decode_cache=None, positions=None):
         B, T, C = x.shape
         head_dim = C // self.n_head
         qkv = nn.Dense(3 * C, dtype=self.dtype, name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (B, T, self.n_head, head_dim)
         q, k, v = (a.reshape(shape) for a in (q, k, v))
+        if decode_cache is not None:
+            # serve-plane decode: B = batch slots, T = 1.  Write this
+            # token's k/v at each slot's own position, then attend the
+            # query over the (just-updated) cache — mask handled by
+            # cached_attention's per-slot position bound.
+            k_cache, v_cache = decode_cache
+            slots = jnp.arange(B)
+            k_cache = k_cache.at[slots, positions].set(k[:, 0])
+            v_cache = v_cache.at[slots, positions].set(v[:, 0])
+            y = cached_attention(q, k_cache, v_cache, positions,
+                                 dtype=self.dtype)
+            y = nn.Dense(C, dtype=self.dtype,
+                         name="proj")(y.reshape(B, T, C))
+            return y, (k_cache, v_cache)
+        # prefill capture: when the caller applies with
+        # mutable=("kv_cache",) the per-layer K/V land in that collection
+        # (serve/engine.py reads them into the slot cache); in every
+        # other apply — training included — sow is a no-op.  Never sown
+        # at init (init makes every collection mutable, which would leak
+        # a kv_cache collection into the train state).
+        if not self.is_initializing():
+            self.sow("kv_cache", "kv", (k, v))
         attend = resolve_attention(self.attention_impl)
         y = attend(q, k, v, causal=self.causal, dtype=self.dtype)
         y = nn.Dense(C, dtype=self.dtype, name="proj")(y.reshape(B, T, C))

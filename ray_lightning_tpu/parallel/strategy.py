@@ -173,6 +173,22 @@ class ShardingStrategy:
     def data_parallel_size(self, mesh: Mesh) -> int:
         return mesh_axis_size(mesh, *self.data_axis_names)
 
+    def kv_cache_spec(self, mesh: Mesh, ndim: int = 5) -> P:
+        """Sharding of the serve plane's slot-indexed KV cache
+        ``[n_layer, slot, pos, head, dim]`` (serve/kvcache.py): slots
+        shard exactly like the batch's leading dim — each data shard
+        decodes its own slots with no cross-device attention traffic.
+        Requires ``max_batch_slots`` divisible by the data-axis size
+        (the serve engine builds its mesh with ``batch_hint=slots`` so
+        single-process meshes clamp instead of erroring)."""
+        if ndim < 2:
+            return P()
+        spec = [None] * ndim
+        spec[1] = (self.data_axis_names
+                   if len(self.data_axis_names) > 1
+                   else self.data_axis_names[0])
+        return P(*spec)
+
     @staticmethod
     def _tree_bytes(tree) -> int:
         import numpy as np
@@ -407,6 +423,15 @@ class SpmdStrategy(ShardingStrategy):
                 and mesh.shape.get("sequence", 1) > 1):
             return P(data, "sequence")
         return P(data)
+
+    def kv_cache_spec(self, mesh: Mesh, ndim: int = 5) -> P:
+        """Slots on the data axes plus heads on ``tensor`` when the mesh
+        has one — the decode attention is head-parallel the same way the
+        training attention is (gpt_partition_rules)."""
+        spec = list(super().kv_cache_spec(mesh, ndim))
+        if ndim >= 4 and mesh.shape.get("tensor", 1) > 1:
+            spec[3] = "tensor"
+        return P(*spec)
 
 
 _STRATEGIES = {

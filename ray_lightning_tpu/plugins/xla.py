@@ -56,10 +56,14 @@ def _configure_worker_jax() -> None:
     platform = os.environ.get("RLT_PLATFORM")
     if platform:
         jax.config.update("jax_platforms", platform)
-        if platform == "cpu":
+        if platform == "cpu" \
+                and int(os.environ.get("RLT_NUM_PROCESSES", "1")) > 1:
             # gloo carries cross-process CPU collectives — the test-time
             # stand-in for ICI, as gloo was the reference's CI stand-in
-            # for NCCL (ray_ddp.py:149-151).
+            # for NCCL (ray_ddp.py:149-151).  Multi-process ONLY: current
+            # jaxlib's gloo backend requires a live distributed client,
+            # so enabling it in a single-worker run (which never calls
+            # jax.distributed.initialize) kills CPU backend init.
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 
@@ -289,6 +293,11 @@ class RayXlaPlugin(ExecutionPlugin):
         # the resolved CommPolicy; the env keeps worker-side tooling that
         # consults RLT_COMM* (e.g. a nested fit) consistent with it
         base_env.update(trainer.comm_policy.worker_env())
+        from ray_lightning_tpu.core import datacheck
+        if datacheck.enabled():
+            # driver-set RLT_DATA_CHECK=1 reaches workers explicitly
+            # (backends that don't inherit the driver env included)
+            base_env[datacheck.ENV_DATA_CHECK] = "1"
         # unique per fit: reusing names across fits in one driver process
         # lets a late/stale connection from a previous run race the new
         # worker's attach
@@ -322,10 +331,21 @@ class RayXlaPlugin(ExecutionPlugin):
                 # windows arrive over the queue during _execution_loop
                 server = _exporter.start_metrics_server(agg, cfg)
                 self._metrics_server = server
+        from ray_lightning_tpu.core import datacheck
+        dc = None
+        if datacheck.enabled() \
+                or self.worker_env.get(datacheck.ENV_DATA_CHECK) == "1":
+            # opt-in divergent-loader detection: workers relay per-step
+            # batch fingerprints over the queue; the driver cross-checks
+            # ranks in process_results and raises on divergence
+            dc = datacheck.DataCheckValidator()
+            datacheck.set_active_validator(dc)
         try:
             return self._execution_loop(trainer, module, datamodule, stage,
                                         ckpt_path, backend)
         finally:
+            if dc is not None:
+                datacheck.set_active_validator(None)
             for w in self._workers:
                 w.kill()  # no_restart parity, ray_ddp.py:383-386
             self._workers = []

@@ -1,0 +1,44 @@
+"""Serving plane: AOT-compiled multi-tenant inference with continuous
+batching (ROADMAP item 1 — the "millions of users, heavy traffic" leg).
+
+Layout:
+
+- ``buckets.py``   — sequence-length buckets (the static-shape contract)
+- ``kvcache.py``   — slot-indexed device KV cache spec + slot free-list
+- ``scheduler.py`` — driver request queue: tenant quota, fair share,
+  continuous batch formation
+- ``engine.py``    — worker engine: per-bucket prefill + one decode
+  program, AOT-compiled through the persistent compilation cache
+- ``worker.py``    — the persistent serve actor (cluster backends)
+- ``server.py``    — the public :class:`Server` endpoint
+- ``selfcheck.py`` — dependency-light invariants for ``format.sh --check``
+"""
+
+from ray_lightning_tpu.serve.buckets import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    bucket_for,
+    pad_to_bucket,
+    resolve_buckets,
+)
+from ray_lightning_tpu.serve.kvcache import (  # noqa: F401
+    KVCacheSpec,
+    SlotAllocator,
+)
+from ray_lightning_tpu.serve.scheduler import (  # noqa: F401
+    Scheduler,
+    ServeRequest,
+)
+from ray_lightning_tpu.serve.server import Server, ServeSpec  # noqa: F401
+
+__all__ = [
+    "Server",
+    "ServeSpec",
+    "Scheduler",
+    "ServeRequest",
+    "KVCacheSpec",
+    "SlotAllocator",
+    "DEFAULT_BUCKETS",
+    "resolve_buckets",
+    "bucket_for",
+    "pad_to_bucket",
+]

@@ -1,0 +1,441 @@
+"""The public serving endpoint: ``Server`` — trained checkpoint in,
+multi-tenant generation out.
+
+Driver-side composition of the serve plane (module docstrings of the
+parts hold the details): a :class:`~ray_lightning_tpu.serve.scheduler.
+Scheduler` forms continuous batches over bucketed sequence lengths, a
+fleet of persistent :class:`~ray_lightning_tpu.serve.worker.ServeWorker`
+actors (one per TPU host, same cluster backends and rendezvous plumbing
+as the fit path) executes them against AOT-compiled prefill/decode
+programs and a strategy-sharded KV cache, and the PR 2 metrics plane
+serves TTFT / TPOT / queue depth / tokens-per-second live on the
+driver's ``/metrics`` endpoint.
+
+::
+
+    server = Server(GPTLightningModule("tiny"), checkpoint=ckpt_path,
+                    num_workers=2, platform="cpu",
+                    buckets=(16, 32), max_batch_slots=8,
+                    telemetry={"metrics_port": 0}).start()
+    req = server.submit(prompt_tokens, tenant="alice")
+    tokens = req.result(timeout=60)          # np.int32 generated ids
+    tokens = server.generate(prompt_tokens)  # submit + wait
+    server.shutdown()                        # graceful drain first
+
+Prompts and completions are token-id arrays — tokenization lives with
+the caller, like every dataset concern in this framework.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ray_lightning_tpu.cluster.backend import get_backend
+from ray_lightning_tpu.cluster.queue import WorkerQueueProxy
+from ray_lightning_tpu.compile import CompileCacheConfig
+from ray_lightning_tpu.parallel.strategy import resolve_strategy
+from ray_lightning_tpu.serve.buckets import resolve_buckets
+from ray_lightning_tpu.serve.scheduler import Scheduler, ServeRequest
+from ray_lightning_tpu.serve.worker import ServeWorker
+from ray_lightning_tpu.telemetry import TelemetryConfig
+from ray_lightning_tpu.util import _handle_queue_item
+from ray_lightning_tpu.utils.platform import host_device_count_flags
+
+_log = logging.getLogger(__name__)
+
+
+@dataclass
+class ServeSpec:
+    """Picklable engine configuration shipped to every serve worker."""
+
+    module: Any
+    strategy: Any
+    buckets: tuple
+    slots: int
+    max_seq_len: int
+    seed: int
+    telemetry: TelemetryConfig
+    compile_cache: CompileCacheConfig
+
+
+class Server:
+    """Multi-tenant generation endpoint over a trained module."""
+
+    def __init__(
+        self,
+        module,
+        checkpoint: Optional[str] = None,
+        *,
+        strategy: Any = None,
+        buckets: Optional[Sequence[int]] = None,
+        max_batch_slots: int = 8,
+        num_workers: int = 1,
+        platform: Optional[str] = None,
+        use_tpu: bool = False,
+        devices_per_worker: Optional[int] = None,
+        max_seq_len: Optional[int] = None,
+        max_new_tokens: int = 32,
+        eos_token: Optional[int] = None,
+        tenant_quotas: "dict[str, int] | int | None" = None,
+        max_prefills_per_step: int = 1,
+        seed: int = 0,
+        default_root_dir: Optional[str] = None,
+        telemetry: Any = None,
+        compile_cache: Any = None,
+        worker_env: Optional[dict] = None,
+    ):
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        self.module = module
+        self.strategy = resolve_strategy(strategy)
+        if max_seq_len is None:
+            cfg = getattr(module, "config", None)
+            max_seq_len = getattr(cfg, "block_size", None)
+            if max_seq_len is None:
+                raise ValueError(
+                    "pass max_seq_len= (module.config has no block_size)")
+        self.max_seq_len = int(max_seq_len)
+        self.buckets = resolve_buckets(buckets, self.max_seq_len)
+        self.max_batch_slots = int(max_batch_slots)
+        self.num_workers = int(num_workers)
+        self.platform = platform or ("tpu" if use_tpu else None)
+        self.use_tpu = use_tpu
+        self.devices_per_worker = devices_per_worker
+        self.seed = int(seed)
+        self.default_root_dir = default_root_dir or os.path.join(
+            os.getcwd(), "rlt_serve")
+        self.telemetry = TelemetryConfig.resolve(telemetry)
+        self.compile_cache = CompileCacheConfig.resolve(compile_cache)
+        self.worker_env = dict(worker_env or {})
+        self.scheduler = Scheduler(
+            self.buckets, self.max_batch_slots, self.max_seq_len,
+            quotas=tenant_quotas,
+            max_prefills_per_step=max_prefills_per_step,
+            default_max_new_tokens=max_new_tokens, eos_token=eos_token)
+        self._weights = self._resolve_weights(module, checkpoint)
+        self._backend = None
+        self._workers: list = []
+        self._queue = None
+        self._agg = None
+        self._metrics_server = None
+        self._pump: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._work = threading.Event()
+        self._draining = False
+        self._started = False
+        self._error: Optional[BaseException] = None
+        self._setup_info: list = []
+        self.telemetry_paths: Optional[dict] = None
+
+    @staticmethod
+    def _resolve_weights(module, checkpoint: Optional[str]):
+        """Weights for the fleet: an msgpack checkpoint path, a module
+        carrying ``_trained_variables`` from a previous ``fit``, or
+        ``None`` (seeded fresh init — benches and smoke tests)."""
+        if checkpoint is not None:
+            from ray_lightning_tpu.core.trainer import Trainer
+            ckpt = Trainer.load_checkpoint_dict(checkpoint)
+            return {"params": ckpt["state"]["params"]}
+        trained = getattr(module, "_trained_variables", None)
+        if trained is not None:
+            return {"params": trained["params"]}
+        return None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Server":
+        """Spawn the fleet, rendezvous, build+warm every engine, start
+        the scheduler pump.  Blocking; returns self."""
+        if self._started:
+            return self
+        backend = get_backend()
+        self._backend = backend
+        base_env = self._worker_env_base()
+        run_tag = uuid.uuid4().hex[:8]
+        self._workers = [
+            backend.create_actor(
+                ServeWorker,
+                env={**base_env, "RLT_PROCESS_ID": str(i)},
+                resources=self._worker_resources(),
+                name=f"rlt-serve-{os.getpid()}-{run_tag}-{i}",
+            )
+            for i in range(self.num_workers)
+        ]
+        try:
+            self._rendezvous()
+            self._start_telemetry()
+            self._queue = (backend.worker_queue_proxy()
+                           if hasattr(backend, "worker_queue_proxy")
+                           else WorkerQueueProxy())
+            spec = ServeSpec(
+                module=self.module, strategy=self.strategy,
+                buckets=self.buckets, slots=self.max_batch_slots,
+                max_seq_len=self.max_seq_len, seed=self.seed,
+                telemetry=self.telemetry,
+                compile_cache=self.compile_cache)
+            payload = (spec, self._weights)
+            ref = None
+            if backend.supports_object_store:
+                payload = ref = backend.put(payload)
+            try:
+                futures = [
+                    w.call("setup_serve", payload, i, self._queue)
+                    for i, w in enumerate(self._workers)]
+                self._setup_info = self._wait_all(futures, timeout=600)
+            finally:
+                if ref is not None:
+                    backend.free(ref)
+        except BaseException:
+            self._kill_workers()
+            raise
+        info = self._setup_info[0]
+        _log.info("serve fleet ready: %d worker(s), mesh=%s, buckets=%s, "
+                  "slots=%d", self.num_workers, info["mesh"],
+                  info["buckets"], info["slots"])
+        self._started = True
+        self._pump = threading.Thread(target=self._pump_loop, daemon=True,
+                                      name="rlt-serve-pump")
+        self._pump.start()
+        return self
+
+    def _worker_env_base(self) -> dict:
+        """Mirror of the fit path's worker env plumbing
+        (plugins/xla.py RayXlaPlugin._worker_env_base)."""
+        env = {"RLT_NUM_PROCESSES": str(self.num_workers)}
+        if self.platform:
+            env["RLT_PLATFORM"] = self.platform
+            env["JAX_PLATFORMS"] = self.platform
+        if self.platform == "cpu":
+            n = self.devices_per_worker or 1
+            env["XLA_FLAGS"] = host_device_count_flags(n)
+            env["RLT_NUM_LOCAL_DEVICES"] = str(n)
+            env["PALLAS_AXON_POOL_IPS"] = ""
+        if self.telemetry.enabled:
+            env["RLT_TELEMETRY"] = "1"
+            env["RLT_HEARTBEAT_INTERVAL"] = str(
+                self.telemetry.heartbeat_interval)
+        env.update(self.compile_cache.worker_env())
+        env.update(self.worker_env)
+        return env
+
+    def _worker_resources(self) -> dict:
+        res: dict = {"CPU": 1.0}
+        if self.use_tpu:
+            res["TPU"] = self.devices_per_worker or 1
+        return res
+
+    def _rendezvous(self) -> None:
+        """PJRT coordinator election + rank env, exactly like a fit
+        (plugins/xla.py)."""
+        workers = self._workers
+        coord_env = {}
+        if self.num_workers > 1:
+            ip = workers[0].call("get_node_ip").result(timeout=120)
+            port = workers[0].call("get_free_port").result(timeout=120)
+            coord_env = {"RLT_COORDINATOR": f"{ip}:{port}"}
+        futs = [w.call("set_env_vars", {**coord_env,
+                                        "RLT_PROCESS_ID": str(i)})
+                for i, w in enumerate(workers)]
+        self._wait_all(futs, timeout=120)
+
+    def _start_telemetry(self) -> None:
+        cfg = self.telemetry
+        if not cfg.enabled:
+            return
+        from ray_lightning_tpu import telemetry
+        from ray_lightning_tpu.telemetry import exporter as _exporter
+        agg = telemetry.TelemetryAggregator(
+            cfg.resolve_dir(self.default_root_dir),
+            heartbeat_timeout=cfg.heartbeat_timeout,
+            hard_timeout=cfg.hard_timeout)
+        for i, w in enumerate(self._workers):
+            agg.register_worker(i, w)
+        telemetry.set_active(agg)
+        self._agg = agg
+        if cfg.metrics:
+            # driver-side registry (rank -1): the scheduler's
+            # TTFT/TPOT/queue-depth/tokens instruments flush straight
+            # into the aggregator and ride the same /metrics exposition
+            # as the workers' windows
+            telemetry.enable_metrics(rank=-1, sink=agg.ingest_metrics,
+                                     interval=cfg.metrics_interval)
+            self._metrics_server = _exporter.start_metrics_server(agg, cfg)
+
+    @property
+    def metrics_url(self) -> Optional[str]:
+        return self._metrics_server.url \
+            if self._metrics_server is not None else None
+
+    # -- request surface ---------------------------------------------------
+
+    def submit(self, prompt, tenant: str = "default",
+               max_new_tokens: Optional[int] = None) -> ServeRequest:
+        """Enqueue a prompt (token ids); returns a handle whose
+        ``result()`` blocks for the generated tokens."""
+        if not self._started:
+            raise RuntimeError("Server.start() first")
+        if self._draining:
+            raise RuntimeError("server is draining; no new requests")
+        if self._error is not None:
+            raise RuntimeError("serve fleet failed") from self._error
+        req = self.scheduler.submit(prompt, tenant=tenant,
+                                    max_new_tokens=max_new_tokens)
+        self._work.set()
+        return req
+
+    def generate(self, prompt, tenant: str = "default",
+                 max_new_tokens: Optional[int] = None,
+                 timeout: Optional[float] = 300.0) -> np.ndarray:
+        """Blocking submit-and-wait."""
+        return self.submit(prompt, tenant=tenant,
+                           max_new_tokens=max_new_tokens).result(timeout)
+
+    # -- the pump ----------------------------------------------------------
+
+    def _pump_loop(self) -> None:
+        sched = self.scheduler
+        if self._agg is not None:
+            # the active aggregator is THREAD-local (aggregator.py: the
+            # tune runner's per-trial threads need their own); the pump
+            # is the thread draining the worker queue, so it must bind
+            # the fleet's aggregator itself or every relayed telemetry
+            # item would be dropped silently
+            from ray_lightning_tpu import telemetry
+            telemetry.set_active(self._agg)
+        while not self._stop.is_set():
+            self._drain_queue()
+            self._watchdog()
+            plan = sched.plan()
+            if plan is None:
+                if self._draining and sched.idle():
+                    return
+                self._work.wait(0.02)
+                self._work.clear()
+                continue
+            try:
+                futures = [w.call("serve_step", plan)
+                           for w in self._workers]
+                results = self._wait_all(futures, timeout=300)
+            except BaseException as e:   # noqa: BLE001 - fleet failure
+                _log.error("serve step failed; failing %d live request(s)",
+                           sched.active_count + sched.queued_count,
+                           exc_info=True)
+                self._error = e
+                sched.fail_all(e)
+                return
+            result = next(r for r in results if r is not None)
+            sched.apply(plan, result)
+
+    def _drain_queue(self) -> None:
+        backend = self._backend
+        while True:
+            item = backend.queue_get_nowait()
+            if item is None:
+                return
+            _handle_queue_item(item)
+
+    def _watchdog(self) -> None:
+        if self._agg is not None:
+            try:
+                self._agg.watchdog_check()
+            except Exception:
+                _log.warning("serve watchdog error", exc_info=True)
+
+    def _wait_all(self, futures, timeout: float) -> list:
+        """Resolve every worker future, relaying queue traffic while
+        waiting (the fit path's process_results discipline)."""
+        deadline = time.monotonic() + timeout
+        while not all(f.done() for f in futures):
+            if self._backend is not None:
+                self._drain_queue()
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"serve worker call not done after {timeout}s")
+            time.sleep(0.002)
+        return [f.result() for f in futures]
+
+    # -- drain / shutdown --------------------------------------------------
+
+    def drain(self, timeout: Optional[float] = 300.0) -> None:
+        """Graceful drain: stop admitting, finish every in-flight and
+        queued request, stop the pump.  Idempotent."""
+        self._draining = True
+        self._work.set()
+        if self._pump is not None and self._pump.is_alive():
+            self._pump.join(timeout)
+            if self._pump.is_alive():
+                raise TimeoutError(f"drain incomplete after {timeout}s")
+
+    def stats(self) -> dict:
+        """Scheduler + worker evidence (trace counts, compile-cache
+        hits) in one dict."""
+        out = {"scheduler": self.scheduler.stats(),
+               "setup": self._setup_info}
+        if self._started and self._workers:
+            try:
+                out["workers"] = self._wait_all(
+                    [w.call("serve_stats") for w in self._workers],
+                    timeout=60)
+            except Exception:
+                _log.warning("serve_stats failed", exc_info=True)
+        return out
+
+    def shutdown(self, graceful: bool = True) -> None:
+        """Drain (when ``graceful``), tear down telemetry and the
+        fleet.  The process-wide cluster backend stays up (it is shared
+        with any co-resident trainer)."""
+        if graceful and self._started and self._error is None:
+            try:
+                self.drain()
+            except TimeoutError:
+                _log.warning("graceful drain timed out; killing fleet")
+        self._stop.set()
+        self._work.set()
+        if self._pump is not None and self._pump.is_alive():
+            self._pump.join(10)
+        if self._started:
+            try:
+                self._wait_all([w.call("teardown_serve")
+                                for w in self._workers], timeout=30)
+            except Exception:
+                _log.warning("serve teardown failed", exc_info=True)
+        self._kill_workers()
+        if self._agg is not None:
+            from ray_lightning_tpu import telemetry
+            telemetry.set_active(None)
+            telemetry.flush_metrics()
+            telemetry.disable_metrics()
+            if self._metrics_server is not None:
+                self._metrics_server.stop()
+            self.telemetry_paths = self._agg.export()
+            if self._metrics_server is not None:
+                self.telemetry_paths["metrics_url"] = \
+                    self._metrics_server.url
+            self._agg = None
+            self._metrics_server = None
+        self._started = False
+
+    def _kill_workers(self) -> None:
+        for w in self._workers:
+            try:
+                w.kill()
+            except Exception:
+                pass
+        self._workers = []
+
+    def __enter__(self) -> "Server":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(graceful=exc[0] is None)
+
+
+__all__ = ["Server", "ServeSpec"]

@@ -1,0 +1,142 @@
+"""The persistent serve actor: the cluster backends' ``serve`` mode.
+
+Training actors live for ONE blocking ``execute(_worker_run, ...)``
+call; a :class:`ServeWorker` instead stays resident — ``setup_serve``
+builds the engine once (jax.distributed join, compile-cache activation,
+telemetry, AOT warmup), then the driver streams ``serve_step`` calls
+for the fleet's whole life.  It extends the generic
+:class:`~ray_lightning_tpu.cluster.executor.RLTExecutor`, so the
+driver-side rendezvous plumbing (node IP / free port / env vars) is the
+same one the fit path uses, under both cluster backends.
+
+Lockstep contract: every worker of a fleet receives the IDENTICAL plan
+and dispatches the same SPMD programs in the same order; rank 0 alone
+returns the produced tokens (outputs are replicated, the others return
+``None`` to keep the RPC thin).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+from ray_lightning_tpu.cluster.executor import RLTExecutor
+
+_log = logging.getLogger(__name__)
+
+
+class ServeWorker(RLTExecutor):
+    """One per TPU host; holds the :class:`ServeEngine` across calls."""
+
+    def __init__(self, env: Optional[dict] = None):
+        super().__init__(env)
+        self._engine = None
+        self._rank = 0
+        self._nproc = 1
+        self._hb = None
+        self._telemetry_cfg = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def setup_serve(self, payload: tuple, rank: int, queue) -> dict:
+        """Join the distributed runtime, enable telemetry, build and
+        warm the engine.  Returns setup facts the driver logs."""
+        from ray_lightning_tpu.plugins.xla import _configure_worker_jax
+        _configure_worker_jax()
+        import jax
+
+        spec, weights = payload
+        self._rank = rank
+        self._nproc = int(os.environ.get("RLT_NUM_PROCESSES", "1"))
+        if self._nproc > 1:
+            jax.distributed.initialize(
+                coordinator_address=os.environ["RLT_COORDINATOR"],
+                num_processes=self._nproc,
+                process_id=rank,
+            )
+        self._setup_telemetry(spec, rank, queue)
+        from ray_lightning_tpu.compile import cache as compile_cache
+        compile_cache.activate(spec.compile_cache)
+
+        from ray_lightning_tpu.serve.engine import ServeEngine
+        self._engine = ServeEngine(
+            spec.module, spec.strategy, spec.buckets, spec.slots,
+            spec.max_seq_len, seed=spec.seed, weights=weights).setup()
+        return {
+            "rank": rank,
+            "mesh": dict(self._engine._mesh.shape),
+            "buckets": list(self._engine.buckets),
+            "slots": self._engine.slots,
+            "kv_shape": list(self._engine.kv_spec.shape),
+            "stats": self._engine.stats(),
+        }
+
+    def _setup_telemetry(self, spec, rank: int, queue) -> None:
+        cfg = getattr(spec, "telemetry", None)
+        self._telemetry_cfg = cfg
+        if cfg is None or not cfg.enabled or queue is None:
+            return
+        from ray_lightning_tpu import telemetry
+        from ray_lightning_tpu.telemetry import heartbeat as hb_mod
+        telemetry.enable(
+            rank=rank,
+            sink=lambda recs, _q=queue, _r=rank: _q.put(
+                (_r, telemetry.spans_item(_r, recs))),
+            capacity=cfg.capacity, flush_every=cfg.flush_every)
+        if cfg.metrics:
+            telemetry.enable_metrics(
+                rank=rank,
+                sink=lambda item, _q=queue, _r=rank: _q.put((_r, item)),
+                interval=cfg.metrics_interval)
+        if not hb_mod.process_heartbeat_active():
+            self._hb = hb_mod.HeartbeatSender(
+                lambda item, _q=queue, _r=rank: _q.put((_r, item)),
+                rank=rank, interval=cfg.heartbeat_interval).start()
+
+    # -- the serving hot path ----------------------------------------------
+
+    def serve_step(self, plan: dict) -> Optional[dict]:
+        """Execute one scheduler plan: admitting prefills, then one
+        decode over every live slot (scheduler.py plan format)."""
+        engine = self._engine
+        if engine is None:
+            raise RuntimeError("serve_step before setup_serve")
+        result: dict[str, Any] = {"prefill": {}, "decode": {}}
+        for p in plan["prefills"]:
+            result["prefill"][p["slot"]] = engine.prefill(
+                p["slot"], p["tokens"], p["length"], p["bucket"])
+        decode = plan.get("decode")
+        if decode is not None:
+            toks = engine.decode(decode["tokens"], decode["positions"])
+            for s in decode["slots"]:
+                result["decode"][s] = int(toks[s])
+        return result if self._rank == 0 else None
+
+    # -- evidence / teardown -----------------------------------------------
+
+    def serve_stats(self) -> dict:
+        return self._engine.stats() if self._engine is not None else {}
+
+    def teardown_serve(self) -> None:
+        """Graceful worker exit: flush telemetry, leave the coordination
+        service cleanly (the fit path's teardown discipline,
+        plugins/xla.py)."""
+        cfg = self._telemetry_cfg
+        if cfg is not None and cfg.enabled:
+            from ray_lightning_tpu import telemetry
+            telemetry.flush_metrics()
+            telemetry.disable_metrics()
+            telemetry.flush()
+            telemetry.disable()
+            if self._hb is not None:
+                self._hb.stop()
+        if self._nproc > 1:
+            import jax
+            try:
+                jax.distributed.shutdown()
+            except RuntimeError:
+                pass
+
+
+__all__ = ["ServeWorker"]

@@ -34,6 +34,10 @@ def _handle_queue_item(item: Any) -> None:
     agg = get_active()
     if agg is not None and agg.maybe_ingest(payload):
         return
+    from ray_lightning_tpu.core.datacheck import get_active_validator
+    dc = get_active_validator()
+    if dc is not None and dc.maybe_ingest(payload):
+        return
     if callable(payload):
         payload()
 
@@ -56,6 +60,10 @@ def process_results(futures: Sequence[Future], backend: ClusterBackend,
         agg = get_active()
         if agg is not None:
             agg.watchdog_check()
+        from ray_lightning_tpu.core.datacheck import get_active_validator
+        dc = get_active_validator()
+        if dc is not None:
+            dc.verify()  # raises on rank divergence (core/datacheck.py)
         for f in pending:
             if f.done():
                 try:
@@ -72,4 +80,8 @@ def process_results(futures: Sequence[Future], backend: ClusterBackend,
         if item is None:
             break
         _handle_queue_item(item)
+    from ray_lightning_tpu.core.datacheck import get_active_validator
+    dc = get_active_validator()
+    if dc is not None:
+        dc.verify()  # divergence relayed in the final flush still raises
     return [f.result() for f in pending]

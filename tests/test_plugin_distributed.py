@@ -282,13 +282,14 @@ def test_cached_chunked_across_actors(tmp_path, seed):
 # the shared-loader contract ("every process prefetches in the same
 # order").  These tests turn that comment into assertions: the env A/B
 # pins that prefetch never changes math on a contract-respecting loader,
-# and the canary documents what a contract VIOLATION produces — silent
-# positional skew that prefetch neither causes nor worsens (each process
-# consumes its own iterator in order either way; pairing across
-# processes is positional, prefetch only moves transfer timing).
+# and the canary proves a contract VIOLATION is now DETECTED — with
+# RLT_DATA_CHECK=1 the workers relay per-step batch fingerprints and the
+# driver raises naming the divergent rank (core/datacheck.py), instead
+# of training on silently skewed batch pairings.
 
 
-def _loss_traj_run(tmp_path, tag, module, prefetch, batches=8):
+def _loss_traj_run(tmp_path, tag, module, prefetch, batches=8,
+                   extra_env=None):
     """Actor-path run relaying rank-0's per-step loss sequence to the
     driver through a file (subprocess actors share the filesystem)."""
     import json
@@ -308,7 +309,8 @@ def _loss_traj_run(tmp_path, tag, module, prefetch, batches=8):
                 with open(self._path, "w") as f:
                     json.dump(self._losses, f)
 
-    plugin = cpu_plugin(2, worker_env={"RLT_STREAM_PREFETCH": prefetch})
+    plugin = cpu_plugin(2, worker_env={"RLT_STREAM_PREFETCH": prefetch,
+                                       **(extra_env or {})})
     trainer = get_trainer(str(tmp_path / f"run_{tag}"), plugins=[plugin],
                           max_epochs=1, limit_train_batches=batches,
                           limit_val_batches=0, checkpoint=False,
@@ -344,13 +346,26 @@ def test_stream_prefetch_ab_across_actors(tmp_path, seed,
                                err_msg="prefetch changed training math")
 
 
-def test_divergent_loader_order_is_out_of_contract(tmp_path, seed,
-                                                   prefetch_on_traj):
+def test_data_check_is_silent_on_honest_loader(tmp_path, seed,
+                                               prefetch_on_traj):
+    """RLT_DATA_CHECK=1 on a contract-respecting loader: the fit
+    completes with the IDENTICAL loss sequence (the fingerprint relay
+    observes, never perturbs)."""
+    checked = _loss_traj_run(
+        tmp_path, "dc_honest",
+        BoringModel(batch_size=8, dataset_length=128), "1",
+        extra_env={"RLT_DATA_CHECK": "1"})
+    np.testing.assert_allclose(prefetch_on_traj, checked, rtol=0, atol=0,
+                               err_msg="data check changed training math")
+
+
+def test_divergent_loader_order_is_detected(tmp_path, seed):
     """A loader whose per-process order diverges beyond the shard stride
-    completes without crash or hang but trains on SKEWED batch pairings
-    (process A's step k meets process B's step n-1-k) — this is the
-    documented out-of-contract behavior, identical with prefetch on and
-    off: the skew belongs to the violation, not to the prefetch seam.
+    used to train on SKEWED batch pairings silently (process A's step k
+    met process B's step n-1-k); under RLT_DATA_CHECK=1 the workers
+    relay per-step batch fingerprints over the queue and the DRIVER
+    raises, naming the divergent rank (core/datacheck.py) — the canary
+    flipped from documenting skew to detecting it.
 
     The canary classes live inside the test so cloudpickle ships them by
     value (module-level test classes serialize by reference, which the
@@ -381,16 +396,8 @@ def test_divergent_loader_order_is_out_of_contract(tmp_path, seed,
                 RandomDataset(32, self.dataset_length, 0),
                 batch_size=self.batch_size)
 
-    honest = prefetch_on_traj
-    skew_on = _loss_traj_run(
-        tmp_path, "skew_on",
-        DivergentBoring(batch_size=8, dataset_length=128), "1")
-    skew_off = _loss_traj_run(
-        tmp_path, "skew_off",
-        DivergentBoring(batch_size=8, dataset_length=128), "0")
-    # the violation produces a different training run (silent skew)...
-    assert not np.allclose(skew_on, honest), \
-        "canary failed to diverge - it proves nothing"
-    # ...and prefetch neither causes nor worsens it
-    np.testing.assert_allclose(skew_on, skew_off, rtol=0, atol=0,
-                               err_msg="prefetch altered the skew")
+    with pytest.raises(Exception, match="divergent data order"):
+        _loss_traj_run(
+            tmp_path, "dc_skew",
+            DivergentBoring(batch_size=8, dataset_length=128), "1",
+            extra_env={"RLT_DATA_CHECK": "1"})
