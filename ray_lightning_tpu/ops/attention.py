@@ -179,7 +179,12 @@ class MultiHeadAttention(nn.Module):
             # serve-plane decode: B = batch slots, T = 1.  Write this
             # token's k/v at each slot's own position, then attend the
             # query over the (just-updated) cache — mask handled by
-            # cached_attention's per-slot position bound.
+            # cached_attention's per-slot position bound.  Shapes are
+            # static, so slots with no live request write too (the
+            # scheduler sends tokens=0/positions=0 for them): a dummy
+            # entry at position 0 the serve plane must overwrite via the
+            # slot's admitting prefill BEFORE the slot decodes — hence
+            # ServeWorker.serve_step dispatches decode before prefills.
             k_cache, v_cache = decode_cache
             slots = jnp.arange(B)
             k_cache = k_cache.at[slots, positions].set(k[:, 0])

@@ -15,11 +15,15 @@ Scheduling policy:
   to the queued tenant with the fewest active slots, ties broken by
   fewest total served tokens, then FIFO arrival — a deficit-style
   policy under which a chatty tenant cannot starve a quiet one.
-- **Continuous batching**: at most ``max_prefills_per_step`` prompt
-  prefills are injected per step (bounding decode-latency jitter for
-  in-flight requests), then ONE decode program advances every live
-  slot a token.  A request admitted at step k starts decoding at step
-  k+1 (its first token comes out of the prefill itself).
+- **Continuous batching**: ONE decode program advances every live
+  slot a token, and at most ``max_prefills_per_step`` prompt prefills
+  are injected per step (bounding decode-latency jitter for in-flight
+  requests).  A request admitted at step k starts decoding at step
+  k+1 (its first token comes out of the prefill itself) — which is why
+  the worker dispatches the decode BEFORE the prefills: the decode's
+  static shapes make it write a dummy position-0 K/V entry for every
+  slot outside ``decode_slots``, and the admitting prefill must land
+  after that write, not before (worker.py serve_step).
 
 Invariants (pinned by tests/test_serve.py and serve/selfcheck.py):
 slot indices are unique among live requests; per-tenant active count

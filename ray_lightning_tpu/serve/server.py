@@ -324,6 +324,16 @@ class Server:
                 futures = [w.call("serve_step", plan)
                            for w in self._workers]
                 results = self._wait_all(futures, timeout=300)
+                # rank 0 alone carries the tokens (worker.py lockstep
+                # contract); all-None means the backend lost it — a
+                # fleet failure like any other, so it must raise INSIDE
+                # this try or the pump dies without failing the
+                # in-flight requests
+                result = next((r for r in results if r is not None), None)
+                if result is None:
+                    raise RuntimeError(
+                        "no serve worker returned a step result "
+                        "(rank 0's return value was lost)")
             except BaseException as e:   # noqa: BLE001 - fleet failure
                 _log.error("serve step failed; failing %d live request(s)",
                            sched.active_count + sched.queued_count,
@@ -331,7 +341,6 @@ class Server:
                 self._error = e
                 sched.fail_all(e)
                 return
-            result = next(r for r in results if r is not None)
             sched.apply(plan, result)
 
     def _drain_queue(self) -> None:

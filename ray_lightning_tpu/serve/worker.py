@@ -97,20 +97,33 @@ class ServeWorker(RLTExecutor):
     # -- the serving hot path ----------------------------------------------
 
     def serve_step(self, plan: dict) -> Optional[dict]:
-        """Execute one scheduler plan: admitting prefills, then one
-        decode over every live slot (scheduler.py plan format)."""
+        """Execute one scheduler plan: one decode over every live slot,
+        then the admitting prefills (scheduler.py plan format).
+
+        The order is load-bearing.  The decode program has static shapes,
+        so it writes K/V for EVERY slot — slots outside ``decode_slots``
+        carry ``tokens=0/positions=0`` and get a dummy write at position
+        0.  Decode never reads a same-step prefill's state (a slot
+        admitted at step k joins the decode at step k+1), so decode-first
+        lets each admitting prefill overwrite its slot's dummy entry;
+        prefill-first would let the dummy write clobber the prompt's
+        position-0 K/V just after the prefill produced it, corrupting
+        every subsequent token (position 0 is always inside the mask).
+        Free slots that are NOT admitted this step keep the dummy entry
+        harmlessly: their next prefill rewrites the whole prefix
+        (kvcache.py invariant)."""
         engine = self._engine
         if engine is None:
             raise RuntimeError("serve_step before setup_serve")
         result: dict[str, Any] = {"prefill": {}, "decode": {}}
-        for p in plan["prefills"]:
-            result["prefill"][p["slot"]] = engine.prefill(
-                p["slot"], p["tokens"], p["length"], p["bucket"])
         decode = plan.get("decode")
         if decode is not None:
             toks = engine.decode(decode["tokens"], decode["positions"])
             for s in decode["slots"]:
                 result["decode"][s] = int(toks[s])
+        for p in plan["prefills"]:
+            result["prefill"][p["slot"]] = engine.prefill(
+                p["slot"], p["tokens"], p["length"], p["bucket"])
         return result if self._rank == 0 else None
 
     # -- evidence / teardown -----------------------------------------------
