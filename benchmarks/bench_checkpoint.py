@@ -18,6 +18,22 @@ Prints exactly ONE JSON line:
       "save_seconds": S, "save_bytes_per_s": B,
       "restore_seconds": S2, "restore_bytes_per_s": B2}, ...]}
 
+Two elastic-plane legs (PR 7) join the same JSON line:
+
+- **reshard** (``--reshard``, default on): save the state on a 2-way
+  data mesh, restore it onto 1-way and 4-way meshes through the
+  elastic reshard path (elastic/reshard.py) — ``reshard_restore_s`` +
+  bytes/s per target.  On CPU the 4-way target runs over 4 virtual
+  host devices (the fake-multinode stand-in the tests use); on real
+  hardware it uses the first 1/2/4 local devices.
+- **snapshot** (``--snapshot-steps N``, default 8): a short
+  BoringModel fit with ``elastic.snapshot_every_n_steps=1`` measuring
+  the async snapshot cost off the critical path — ``snapshots``,
+  ``skipped`` (bounded backpressure) and the measured
+  ``rlt_snapshot_stall_seconds_total`` / ``rlt_snapshot_seconds_total``
+  sums, so "async snapshots add bounded stall" is a number, not a
+  claim.
+
 Defaults to the gpt2-small and gpt2-medium configs (the driver runs
 this on TPU hosts); ``--configs tiny`` keeps CPU smoke runs tractable.
 """
@@ -117,13 +133,131 @@ def _bench_orbax(state, shardings, workdir: str) -> dict:
     return {"save_seconds": save_s, "restore_seconds": restore_s}
 
 
+def _build_state_on(config: str, strategy_name: str, devices):
+    """Like :func:`_build_state` but meshed over an explicit device
+    list — the reshard leg's way of standing up N-way topologies on
+    one host."""
+    import jax
+
+    from ray_lightning_tpu.core.steps import build_init_fn
+    from ray_lightning_tpu.models.gpt import GPTLightningModule
+    from ray_lightning_tpu.parallel.strategy import resolve_strategy
+
+    module = GPTLightningModule(config, dataset_size=2, batch_size=1)
+    module.setup_model()
+    tx = module.configure_optimizers()
+    strat = resolve_strategy(strategy_name)
+    mesh = strat.build_mesh(devices=devices, batch_hint=len(devices))
+    batch = jax.tree_util.tree_map(
+        np.asarray, next(iter(module.train_dataloader())))
+    init_fn = build_init_fn(module, tx)
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0), batch)
+    shardings = strat.state_shardings(mesh, abstract)
+    state = jax.jit(init_fn, out_shardings=shardings)(
+        jax.random.PRNGKey(0), batch)
+    jax.block_until_ready(state)
+    return state, shardings
+
+
+def _bench_reshard(config: str, strategy: str, workdir: str) -> list:
+    """Save on a 2-way data mesh; reshard-restore onto 1-way and 4-way
+    meshes (elastic/reshard.py).  Emits one row per target world."""
+    import jax
+
+    from ray_lightning_tpu.elastic.reshard import restore_resharded
+    from ray_lightning_tpu.utils.checkpoint import ShardedCheckpointer
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        print(f"# reshard leg skipped: {len(devices)} devices < 4",
+              file=sys.stderr)
+        return []
+    state, _sh = _build_state_on(config, strategy, devices[:2])
+    nbytes = _state_bytes(state)
+    directory = os.path.join(workdir, "reshard_src")
+    ckpt = ShardedCheckpointer(directory)
+    ckpt.save(0, state, {"bench": True, "world": 2})
+    ckpt.wait()
+    ckpt.close()
+    del state
+
+    rows = []
+    for target_world in (1, 4):
+        tstate, tsh = _build_state_on(config, strategy,
+                                      devices[:target_world])
+        ckpt = ShardedCheckpointer(directory)
+        t0 = time.monotonic()
+        restored, _meta = restore_resharded(ckpt, tstate, tsh, step=0)
+        jax.block_until_ready(restored)
+        reshard_s = time.monotonic() - t0
+        ckpt.close()
+        rows.append({
+            "config": config,
+            "path": "orbax_reshard",
+            "save_world": 2,
+            "restore_world": target_world,
+            "state_bytes": nbytes,
+            "reshard_restore_s": round(reshard_s, 3),
+            "restore_bytes_per_s": int(nbytes / max(reshard_s, 1e-9)),
+        })
+        del tstate, restored
+    return rows
+
+
+def _bench_snapshot(steps: int, workdir: str) -> dict:
+    """Async per-step snapshot cost on a live (local) fit: the
+    cadence fires EVERY step, so the stall/skip counters show the
+    backpressure behavior at its worst."""
+    from ray_lightning_tpu import Trainer
+    from ray_lightning_tpu.models import BoringModel
+
+    snap = os.path.join(workdir, "elastic")
+    trainer = Trainer(
+        max_epochs=10**6, max_steps=steps, enable_checkpointing=False,
+        num_sanity_val_steps=0, limit_val_batches=0, seed=0,
+        log_every_n_steps=10**6, default_root_dir=workdir,
+        elastic={"snapshot_every_n_steps": 1, "snapshot_dir": snap,
+                 "max_to_keep": 2})
+    t0 = time.monotonic()
+    trainer.fit(BoringModel(dataset_length=max(64, 2 * steps)))
+    wall = time.monotonic() - t0
+    stats = trainer.elastic_stats() or {}
+    return {
+        "config": "boring",
+        "path": "elastic_snapshot",
+        "steps": steps,
+        "wall_seconds": round(wall, 3),
+        "snapshots": stats.get("snapshots", 0),
+        "skipped": stats.get("skipped", 0),
+        "rlt_snapshot_seconds_total":
+            round(stats.get("save_seconds", 0.0), 4),
+        "rlt_snapshot_stall_seconds_total":
+            round(stats.get("stall_seconds", 0.0), 4),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--configs", default="gpt2-small,gpt2-medium",
                     help="comma-separated model configs (models/gpt.py)")
     ap.add_argument("--strategy", default="zero1",
                     help="sharding strategy for the measured state")
+    ap.add_argument("--reshard", dest="reshard", action="store_true",
+                    default=True, help="run the N->M reshard leg")
+    ap.add_argument("--no-reshard", dest="reshard", action="store_false")
+    ap.add_argument("--snapshot-steps", type=int, default=8,
+                    help="steps for the async-snapshot leg (0 = skip)")
     args = ap.parse_args(argv)
+
+    # the reshard leg needs >= 4 devices; on a forced-CPU run stand up
+    # 4 virtual host devices BEFORE jax initializes (the conftest /
+    # fake-multinode trick) — real TPU hosts already have >= 4 chips
+    if args.reshard and os.environ.get("JAX_PLATFORMS", "") == "cpu" \
+            and "xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=4").strip()
 
     rows = []
     for config in [c for c in args.configs.split(",") if c]:
@@ -145,6 +279,13 @@ def main(argv=None) -> int:
                         nbytes / max(r["restore_seconds"], 1e-9)),
                 })
         del state
+        if args.reshard:
+            with tempfile.TemporaryDirectory(
+                    prefix="rlt_ckpt_reshard_") as d:
+                rows.extend(_bench_reshard(config, args.strategy, d))
+    if args.snapshot_steps > 0:
+        with tempfile.TemporaryDirectory(prefix="rlt_ckpt_snap_") as d:
+            rows.append(_bench_snapshot(args.snapshot_steps, d))
     print(json.dumps({"metric": "checkpoint_io", "unit": "seconds",
                       "rows": rows}))
     return 0

@@ -55,6 +55,12 @@ if [[ "$CHECK" == 1 ]]; then
     # (ray_lightning_tpu/serve/selfcheck.py)
     python -c 'import sys; from ray_lightning_tpu.serve.selfcheck \
         import _main; sys.exit(_main([]))'
+    # elastic-plane selfcheck: ElasticConfig validation + RLT_ELASTIC*
+    # env round-trip, fault-spec parsing, elastic metric names, and the
+    # residual re-bucket's injected-error invariant on a CPU array
+    # (ray_lightning_tpu/elastic/selfcheck.py)
+    python -c 'import sys; from ray_lightning_tpu.elastic.selfcheck \
+        import _main; sys.exit(_main([]))'
 fi
 
 if [[ "$ALL" == 1 ]]; then

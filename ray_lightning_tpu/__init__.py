@@ -50,6 +50,7 @@ from ray_lightning_tpu.plugins import (
     RayXlaSpmdPlugin,
 )
 from ray_lightning_tpu.comm import CommPolicy
+from ray_lightning_tpu.elastic import ElasticConfig
 
 __version__ = "0.1.0"
 
@@ -81,6 +82,7 @@ __all__ = [
     "RayXlaShardedPlugin",
     "RayXlaSpmdPlugin",
     "CommPolicy",
+    "ElasticConfig",
     "Server",
     "__version__",
 ]

@@ -62,7 +62,18 @@ class ActorHandle:
 
     def alive(self) -> Optional[bool]:
         """Cheap liveness probe for watchdog diagnostics (telemetry/):
-        True/False when the backend can tell, None when it cannot."""
+        True/False when the backend can tell, None when it cannot.
+        May read a wedged-but-responsive-process actor as not-alive —
+        exactly what a watchdog should report."""
+        return None
+
+    def process_alive(self) -> Optional[bool]:
+        """STRICT process-level liveness for the elastic shrink
+        classifier (elastic/driver.py): True/False only when the
+        backend can answer precisely — a busy-but-alive actor MUST
+        read True here (unlike :meth:`alive`, whose ping-style probes
+        time out on busy actors), because a False verdict turns a
+        failure into a restartable death.  None when unknown."""
         return None
 
 

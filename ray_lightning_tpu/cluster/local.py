@@ -193,6 +193,11 @@ class LocalActorHandle(ActorHandle):
             return None
         return self._proc.poll() is None
 
+    def process_alive(self) -> Optional[bool]:
+        # the subprocess poll IS process-precise: a busy worker still
+        # reads alive, so this doubles as the strict elastic probe
+        return self.alive()
+
     def kill(self) -> None:
         """Hard-stop the actor (``ray.kill(no_restart=True)`` analog,
         ray_ddp.py:384)."""

@@ -138,6 +138,21 @@ class RayActorHandle(ActorHandle):
         except Exception:
             return False
 
+    def process_alive(self) -> Optional[bool]:
+        """Strict probe for the elastic shrink classifier: the actor's
+        GCS-reported state, which a busy actor does not affect (the
+        ping probe above would misread a mid-collective worker as dead
+        and turn a user exception into a shrink).  None when the state
+        API is unavailable in this Ray build."""
+        try:
+            from ray.util.state import get_actor
+            st = get_actor(self.actor_id)
+            if st is None:
+                return None
+            return str(getattr(st, "state", "")).upper() != "DEAD"
+        except Exception:
+            return None
+
 
 class RayBackend(ClusterBackend):
     supports_object_store = True

@@ -67,7 +67,7 @@ _RUNTIME_FIELDS = (
     "_state_shardings", "_abstract_state", "_tx", "_init_fn", "_init_rng",
     "_multi_train_step", "_stacked_batch_shardings",
     "_cache_source", "_cached_multi_step", "_cached_single_step",
-    "_precompiler", "_abstract_batch", "_grad_sync",
+    "_precompiler", "_abstract_batch", "_grad_sync", "_snapshotter",
 )
 
 # every spelling (PL 1.x and 2.x) that means "half-precision inputs";
@@ -110,6 +110,7 @@ class Trainer:
         telemetry: Any = None,
         compile_cache: Any = None,
         comm_policy: Any = None,
+        elastic: Any = None,
     ):
         if max_epochs is None and (max_steps is None or max_steps < 0):
             max_epochs = 1000
@@ -175,6 +176,12 @@ class Trainer:
         # pickles driver→worker with the trainer.
         from ray_lightning_tpu.comm import CommPolicy
         self.comm_policy = CommPolicy.resolve(comm_policy)
+        # elastic plane (elastic/): async snapshots + shrink-to-continue
+        # fault tolerance.  None defers to the RLT_ELASTIC* env knobs;
+        # off (the default) keeps every path below inert.  The frozen
+        # config pickles driver→worker with the trainer.
+        from ray_lightning_tpu.elastic import ElasticConfig
+        self.elastic = ElasticConfig.resolve(elastic)
         from ray_lightning_tpu.utils.logger import resolve_logger
         self.logger = resolve_logger(logger, self.default_root_dir)
 
@@ -216,6 +223,14 @@ class Trainer:
         self._warned_skip = False
         self._stage = None
         self._sharded_checkpointers: dict = {}
+        self._snapshotter = None
+        #: shrink-to-continue bookkeeping, set by the elastic driver on
+        #: the driver trainer (rides the pickle to workers — the loader
+        #: rescale reads it) and summarized into _elastic_report
+        self._elastic_state: Optional[dict] = None
+        self._elastic_report: Optional[dict] = None
+        self._elastic_worker_stats: Optional[dict] = None
+        self._warned_rescale = False
 
     # ------------------------------------------------------------------
     # pickling across the driver→worker boundary (ray_ddp.py:164-172
@@ -318,6 +333,23 @@ class Trainer:
             "node_rank": jax.process_index(),
         }
 
+        # deterministic fault injection (elastic/faults.py): RLT_FAULT
+        # in this process's env arms kill/wedge/slow-rank-k-at-step-s
+        # for chaos tests and benches
+        from ray_lightning_tpu.elastic.faults import (FaultInjector,
+                                                      maybe_injector_from_env)
+        if not any(isinstance(c, FaultInjector) for c in self.callbacks):
+            injector = maybe_injector_from_env()
+            if injector is not None:
+                self.callbacks.append(injector)
+        # elastic snapshotting (elastic/snapshot.py): cadence-driven
+        # async sharded saves off the critical path, fit only
+        self._snapshotter = None
+        if stage == "fit" and self.elastic.enabled \
+                and self.elastic.snapshot_every_n_steps > 0:
+            from ray_lightning_tpu.elastic.snapshot import Snapshotter
+            self._snapshotter = Snapshotter(self, self.elastic)
+
         # persistent XLA compilation cache: activated before the first
         # jit so every program of this stage (init, train, eval) is a
         # disk hit when a previous process — an earlier tune trial, a
@@ -404,10 +436,58 @@ class Trainer:
             src = getattr(self.datamodule, f"{name}_dataloader")()
         if src is None:
             src = getattr(self.lightning_module, f"{name}_dataloader")()
+        if src is not None:
+            src = self._elastic_rescale_loader(src, name)
         if src is not None and self.use_distributed_sampler \
                 and self.world_size > 1 and hasattr(src, "shard"):
             src = src.shard(self.world_size, self.global_rank)
         return src
+
+    def _elastic_rescale_loader(self, src, name: str):
+        """After a shrink-to-continue restart the fleet has fewer
+        workers than the run started with; preserve the GLOBAL batch
+        (world × per-worker batch — the quantity the optimization
+        trajectory depends on) by scaling each survivor's loader batch
+        by ``initial_workers / current_workers``.  This is the batch
+        half of the resume-with-fewer-workers redistribution the
+        checkpoint re-shard does for state (:meth:`_restore_sharded`).
+        No-op outside an elastic restart."""
+        es = getattr(self, "_elastic_state", None)
+        if not es or not self.elastic.enabled \
+                or not self.elastic.preserve_global_batch:
+            return src
+        initial = es.get("initial_workers") or 0
+        current = self.world_size
+        if initial <= 0 or initial == current:
+            return src
+        bs = getattr(src, "batch_size", None)
+        if bs is None or not hasattr(src, "shard"):
+            if not self._warned_rescale:
+                self._warned_rescale = True
+                _log.warning(
+                    "elastic: cannot rescale %s loader %r (no "
+                    "batch_size); global batch shrinks %d -> %d "
+                    "workers' worth", name, type(src).__name__,
+                    initial, current)
+            return src
+        total = int(bs) * initial
+        if total % current:
+            if not self._warned_rescale:
+                self._warned_rescale = True
+                _log.warning(
+                    "elastic: global batch %d does not divide across "
+                    "%d surviving workers; keeping per-worker batch "
+                    "%d", total, current, bs)
+            return src
+        import copy
+        clone = copy.copy(src)
+        clone.batch_size = total // current
+        _log.info(
+            "elastic: %s loader batch %d -> %d on each of %d "
+            "survivors (global batch %d preserved from the %d-worker "
+            "topology)", name, bs, clone.batch_size, current, total,
+            initial)
+        return clone
 
     def _build_loaders(self, stage: str) -> dict:
         if stage == "fit":
@@ -987,6 +1067,8 @@ class Trainer:
             metrics = source.run_one(self, item)
         self.global_step += 1
         _metrics.on_step(time.monotonic() - t0, step=self.global_step)
+        if self._snapshotter is not None:
+            self._snapshotter.maybe_snapshot()
         self._note_first_step(metrics)
         self._accumulate_metrics(metrics)
         if self.global_step % self.log_every_n_steps == 0:
@@ -1016,6 +1098,10 @@ class Trainer:
         self.global_step += len(items)
         _metrics.on_step(time.monotonic() - t0, k=len(items),
                          step=self.global_step)
+        if self._snapshotter is not None:
+            # chunked dispatch coarsens the snapshot cadence to chunk
+            # boundaries, like the batch-granular callbacks do
+            self._snapshotter.maybe_snapshot()
         self._note_first_step(metrics)
         self._accumulate_metrics(metrics)
         self._publish_if_crossed(before, jax.tree_util.tree_map(
@@ -1284,13 +1370,11 @@ class Trainer:
                         f.write(payload)
                     os.replace(tmp, filepath)
 
-    def save_sharded_checkpoint(self, directory: str,
-                                step: Optional[int] = None,
-                                max_to_keep: Optional[int] = None) -> None:
-        """Sharded (orbax) save: every process writes only its own array
-        shards, asynchronously — no host gather, unlike
-        :meth:`save_checkpoint` (utils/checkpoint.py rationale).  All
-        processes must call this (collective)."""
+    def _sharded_checkpointer(self, directory: str,
+                              max_to_keep: Optional[int] = None):
+        """The live orbax manager for ``directory`` (created on first
+        use, cached per fit — the elastic snapshotter probes it for
+        backpressure before each save)."""
         from ray_lightning_tpu.utils.checkpoint import ShardedCheckpointer
         ckpt = self._sharded_checkpointers.get(directory)
         if ckpt is not None and ckpt.max_to_keep != max_to_keep:
@@ -1303,6 +1387,16 @@ class Trainer:
         if ckpt is None:
             ckpt = ShardedCheckpointer(directory, max_to_keep=max_to_keep)
             self._sharded_checkpointers[directory] = ckpt
+        return ckpt
+
+    def save_sharded_checkpoint(self, directory: str,
+                                step: Optional[int] = None,
+                                max_to_keep: Optional[int] = None) -> None:
+        """Sharded (orbax) save: every process writes only its own array
+        shards, asynchronously — no host gather, unlike
+        :meth:`save_checkpoint` (utils/checkpoint.py rationale).  All
+        processes must call this (collective)."""
+        ckpt = self._sharded_checkpointer(directory, max_to_keep)
         module = self.lightning_module
         meta = {
             "epoch": int(self.current_epoch),
@@ -1314,6 +1408,15 @@ class Trainer:
             "callbacks": {type(cb).__name__: _sanitize(cb.state_dict())
                           for cb in self.callbacks},
         }
+        from ray_lightning_tpu.comm.collectives import CommState
+        if isinstance(self.state.opt_state, CommState):
+            res = jax.tree_util.tree_leaves(self.state.opt_state.residual)
+            if res:
+                # the error-feedback residual's stacked world size — the
+                # reshard restore re-buckets this axis N→M on a topology
+                # change (elastic/reshard.py; recorded for forensics,
+                # the restore itself reads orbax metadata)
+                meta["comm_world"] = int(res[0].shape[0])
         ckpt.save(step if step is not None else int(self.global_step),
                   self.state, meta)
 
@@ -1321,6 +1424,19 @@ class Trainer:
         """Block until in-flight async sharded saves are durable."""
         for ckpt in self._sharded_checkpointers.values():
             ckpt.wait()
+
+    def elastic_stats(self) -> Optional[dict]:
+        """Elastic-plane numbers for THIS process: snapshot counters
+        (snapshots / skipped / save_seconds / stall_seconds) plus the
+        shrink bookkeeping the driver stamped on the trainer.  Rank-0's
+        copy rides the worker result package back to the driver, which
+        folds it into ``trainer._elastic_report``."""
+        out: dict = {}
+        if self._snapshotter is not None:
+            out.update(self._snapshotter.stats)
+        if self._elastic_state:
+            out.update(self._elastic_state)
+        return out or None
 
     def _close_sharded_checkpointers(self) -> None:
         """Wait + release orbax managers (their async worker threads
@@ -1368,17 +1484,21 @@ class Trainer:
         """Restore from an orbax directory (root → latest step; a
         specific step dir works too), re-sharding straight into the
         CURRENT mesh — the full state never materializes on one host
-        (utils/checkpoint.py).  Consequently the ``on_load_checkpoint``
-        hooks receive the checkpoint *metadata* (same top-level keys as
-        :meth:`dump_checkpoint` minus ``state``) — see
-        LightningModule.on_load_checkpoint."""
-        from ray_lightning_tpu.utils.checkpoint import (ShardedCheckpointer,
-                                                        abstract_like)
+        (utils/checkpoint.py).  The topology may differ from the one
+        that saved (N→M hosts, strategy swap): global shapes are
+        topology-independent except the comm plane's ``[world, ...]``
+        error-feedback residual, which elastic/reshard.py re-buckets
+        instead of blindly reloading.  Consequently the
+        ``on_load_checkpoint`` hooks receive the checkpoint *metadata*
+        (same top-level keys as :meth:`dump_checkpoint` minus
+        ``state``) — see LightningModule.on_load_checkpoint."""
+        from ray_lightning_tpu.elastic.reshard import restore_resharded
+        from ray_lightning_tpu.utils.checkpoint import ShardedCheckpointer
         root, step = ShardedCheckpointer.split_step_dir(directory)
         ckpt = ShardedCheckpointer(root)
         try:
-            state, meta = ckpt.restore(
-                abstract_like(self.state, self._state_shardings), step=step)
+            state, meta = restore_resharded(
+                ckpt, self.state, self._state_shardings, step=step)
         finally:
             ckpt.close()
         self.state = state

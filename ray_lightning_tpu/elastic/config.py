@@ -1,0 +1,142 @@
+"""Elastic-plane configuration.
+
+``ElasticConfig`` turns on the fault-tolerance subsystem
+(ray_lightning_tpu/elastic/): async per-step snapshots off the critical
+path, reshardable restore of those snapshots onto a different topology,
+and the shrink-to-continue driver that reacts to a dead rank by
+rebuilding the fleet with the survivors instead of failing the run.
+
+Construction paths (first match wins, mirroring TelemetryConfig /
+CompileCacheConfig / CommPolicy):
+
+- ``Trainer(elastic=ElasticConfig(...))`` — full control;
+- ``Trainer(elastic=True)`` — defaults (snapshotting still needs
+  ``snapshot_every_n_steps``/``RLT_ELASTIC_EVERY`` to be set);
+- ``Trainer(elastic={...})`` — kwargs dict (enabled unless it says
+  otherwise);
+- ``RLT_ELASTIC=1`` (+ ``RLT_ELASTIC_EVERY=50``, ``RLT_ELASTIC_DIR``,
+  ``RLT_ELASTIC_MAX_RESTARTS``, ``RLT_ELASTIC_MIN_WORKERS``,
+  ``RLT_ELASTIC_KEEP``, ``RLT_ELASTIC_PRESERVE_BATCH``) — env knobs,
+  read when the Trainer arg is ``None``.
+
+The resolved config is a frozen dataclass pickled driver→worker with
+the trainer; the env knobs additionally round-trip through
+``worker_env()`` (plugins/xla.py) like ``RLT_COMM*`` does, so
+worker-side tooling that consults ``RLT_ELASTIC*`` stays consistent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name, "").strip()
+    if raw in ("0", "false", "False"):
+        return False
+    if raw in ("1", "true", "True"):
+        return True
+    return default
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    """How the run survives worker loss.
+
+    enabled: master switch — snapshotting, fault injection plumbing and
+        the shrink-to-continue driver all key off it.
+    snapshot_every_n_steps: async sharded-snapshot cadence (0 = no
+        periodic snapshots; the shrink driver then falls back to the
+        original ``resume_from_checkpoint`` or a from-scratch restart).
+    snapshot_dir: where snapshots land; ``None`` =
+        ``<default_root_dir>/elastic``.  Must be visible to every worker
+        process (shared FS or ``gs://...`` — orbax per-shard saves are
+        collective).
+    max_restarts: how many shrink-and-continue attempts before the
+        original failure propagates.
+    min_workers: never shrink the fleet below this.
+    preserve_global_batch: rescale each surviving worker's loader batch
+        by ``initial_workers / current_workers`` so the global batch
+        (and therefore the optimization trajectory) is preserved across
+        a shrink — the resume-with-fewer-workers redistribution the
+        checkpoint re-shard already does for state (core/trainer.py).
+    max_to_keep: snapshot retention (orbax ``max_to_keep``).
+    """
+
+    enabled: bool = False
+    snapshot_every_n_steps: int = 0
+    snapshot_dir: Optional[str] = None
+    max_restarts: int = 2
+    min_workers: int = 1
+    preserve_global_batch: bool = True
+    max_to_keep: Optional[int] = 2
+
+    def __post_init__(self):
+        if self.snapshot_every_n_steps < 0:
+            raise ValueError("elastic snapshot_every_n_steps must be >= 0")
+        if self.max_restarts < 0:
+            raise ValueError("elastic max_restarts must be >= 0")
+        if self.min_workers < 1:
+            raise ValueError("elastic min_workers must be >= 1")
+        if self.max_to_keep is not None and self.max_to_keep < 1:
+            raise ValueError("elastic max_to_keep must be >= 1 or None")
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def resolve(cls, value: Any) -> "ElasticConfig":
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, bool):
+            return cls(enabled=value)
+        if isinstance(value, dict):
+            cfg = dict(value)
+            cfg.setdefault("enabled", True)
+            return cls(**cfg)
+        if value is not None:
+            raise TypeError(f"bad elastic config: {value!r}")
+        keep_raw = os.environ.get("RLT_ELASTIC_KEEP", "").strip()
+        return cls(
+            enabled=_env_flag("RLT_ELASTIC", False),
+            snapshot_every_n_steps=int(
+                os.environ.get("RLT_ELASTIC_EVERY", "0") or 0),
+            snapshot_dir=os.environ.get("RLT_ELASTIC_DIR") or None,
+            max_restarts=int(
+                os.environ.get("RLT_ELASTIC_MAX_RESTARTS", "2") or 2),
+            min_workers=int(
+                os.environ.get("RLT_ELASTIC_MIN_WORKERS", "1") or 1),
+            preserve_global_batch=_env_flag(
+                "RLT_ELASTIC_PRESERVE_BATCH", True),
+            max_to_keep=int(keep_raw) if keep_raw else 2,
+        )
+
+    # -- env round-trip --------------------------------------------------
+
+    def worker_env(self) -> dict:
+        """Env mapping reproducing this config via :meth:`resolve` in a
+        worker process (the pickled trainer already carries the config;
+        the env keeps worker-side nested fits consistent)."""
+        if not self.enabled:
+            return {}
+        env = {
+            "RLT_ELASTIC": "1",
+            "RLT_ELASTIC_EVERY": str(self.snapshot_every_n_steps),
+            "RLT_ELASTIC_MAX_RESTARTS": str(self.max_restarts),
+            "RLT_ELASTIC_MIN_WORKERS": str(self.min_workers),
+            "RLT_ELASTIC_PRESERVE_BATCH":
+                "1" if self.preserve_global_batch else "0",
+        }
+        if self.snapshot_dir:
+            env["RLT_ELASTIC_DIR"] = self.snapshot_dir
+        if self.max_to_keep is not None:
+            env["RLT_ELASTIC_KEEP"] = str(self.max_to_keep)
+        return env
+
+    # -- paths -----------------------------------------------------------
+
+    def resolve_dir(self, default_root_dir: str) -> str:
+        if self.snapshot_dir:
+            return self.snapshot_dir
+        return os.path.join(default_root_dir, "elastic")

@@ -1,0 +1,150 @@
+"""Shrink-to-continue: the driver-side reaction to a lost worker.
+
+The reference's failure story (SURVEY.md §5) ends at "raise on the
+driver"; the elastic driver goes the rest of the way.  When a fit
+attempt fails because a rank *died* (process gone / connection lost /
+heartbeat hard-timeout — NOT a deterministic user exception, which
+still propagates), the driver:
+
+1. tears down the surviving actors (the plugin's normal teardown —
+   every attempt gets a fresh fleet, so a wedged-but-alive rank is
+   removed the same way a dead one is);
+2. shrinks ``plugin.num_workers`` by the number of dead ranks (at
+   least one), bounded by ``min_workers``/``max_restarts``;
+3. finds the latest durable elastic snapshot (orbax only lists
+   committed steps, so a save the dead fleet never finalized is
+   invisible) and points the resume at it — falling back to the
+   original ``ckpt_path`` (or a from-scratch restart) when no snapshot
+   landed;
+4. re-runs the attempt: fresh actors, fresh PJRT rendezvous on the new
+   world size, reshard-restore into the new mesh
+   (elastic/reshard.py), per-worker batch rescaled so the global batch
+   is preserved (``Trainer._elastic_rescale_loader``), training
+   continuing to ``max_steps``.  Recompiles for the new topology
+   warm-start through the persistent compile cache (compile/) — the
+   topology namespace may be cold but the driver's cache dir survives
+   the fleet.
+
+``rlt_restarts_total`` and the per-rank ``rlt_worker_alive`` gauges
+(telemetry/aggregator.py) put the shrink on ``/metrics`` so dashboards
+see fleet health, not just driver-log text.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+from ray_lightning_tpu.telemetry.aggregator import WorkerHeartbeatTimeout
+
+_log = logging.getLogger(__name__)
+
+#: substrings of a failure message that mean "the process is gone"
+#: even when the liveness probe could not say so (backends whose
+#: ``alive()`` returns None)
+_DEATH_MARKERS = ("connection lost", "died", "never connected",
+                  "heartbeat")
+
+
+def _restartable(err: BaseException, dead_ranks: list) -> bool:
+    """A failure the elastic driver may absorb: a dead process, a lost
+    connection, or a heartbeat hard-timeout.  Deterministic user
+    exceptions re-raise — shrinking would just re-run the bug."""
+    if dead_ranks:
+        return True
+    if isinstance(err, WorkerHeartbeatTimeout):
+        return True
+    msg = str(err).lower()
+    return any(m in msg for m in _DEATH_MARKERS)
+
+
+def latest_snapshot_step(directory: str) -> Optional[int]:
+    """Latest COMMITTED snapshot step under ``directory`` (None when
+    the directory is empty or absent)."""
+    from ray_lightning_tpu.utils.checkpoint import ShardedCheckpointer
+    if not ShardedCheckpointer.is_sharded_checkpoint(directory):
+        return None
+    ckpt = ShardedCheckpointer(directory)
+    try:
+        return ckpt.latest_step()
+    finally:
+        ckpt.close()
+
+
+def run_elastic_fit(plugin, trainer, module, datamodule,
+                    ckpt_path: Optional[str]):
+    """Drive ``plugin._run_attempt`` with shrink-and-continue retries.
+
+    Returns the (eventually) successful attempt's result; sets
+    ``trainer._elastic_report`` with the restart history.
+    """
+    cfg = trainer.elastic
+    snap_dir = cfg.resolve_dir(trainer.default_root_dir)
+    initial = plugin.num_workers
+    restarts = 0
+    report = {"initial_workers": initial, "workers": initial,
+              "restarts": 0, "resumed_step": None}
+    while True:
+        # rides the pickled trainer to the workers: the loader rescale
+        # and the worker-side stats both read it
+        trainer._elastic_state = dict(report)
+        plugin._elastic_restarts = restarts
+        try:
+            result = plugin._run_attempt(trainer, module, datamodule,
+                                         "fit", ckpt_path)
+        except BaseException as err:   # noqa: BLE001 - classified below
+            dead = list(getattr(plugin, "_last_dead_ranks", ()) or ())
+            if not _restartable(err, dead):
+                raise
+            restarts += 1
+            shrink = max(1, len(dead))
+            new_workers = plugin.num_workers - shrink
+            if restarts > cfg.max_restarts:
+                _log.error(
+                    "elastic: restart budget exhausted (%d); raising",
+                    cfg.max_restarts)
+                raise
+            if new_workers < cfg.min_workers:
+                _log.error(
+                    "elastic: shrinking %d -> %d would go below "
+                    "min_workers=%d; raising", plugin.num_workers,
+                    new_workers, cfg.min_workers)
+                raise
+            step = latest_snapshot_step(snap_dir)
+            if step is not None:
+                resume = os.path.join(snap_dir, str(step))
+            else:
+                resume = ckpt_path
+                _log.warning(
+                    "elastic: no durable snapshot under %s; restarting "
+                    "from %s", snap_dir,
+                    resume or "scratch (step 0)")
+            _log.warning(
+                "elastic: worker failure (%s: %s); dead ranks %s — "
+                "shrinking %d -> %d workers (restart %d/%d) and "
+                "resuming from %s",
+                type(err).__name__, str(err).splitlines()[0][:200],
+                dead or "unknown", plugin.num_workers, new_workers,
+                restarts, cfg.max_restarts, resume or "scratch")
+            plugin.num_workers = new_workers
+            # drop stale queue traffic from the dead fleet so a relayed
+            # callable from attempt k never executes during attempt k+1
+            backend = getattr(plugin, "_backend", None)
+            if backend is not None:
+                while backend.queue_get_nowait() is not None:
+                    pass
+            ckpt_path = resume
+            report = {"initial_workers": initial,
+                      "workers": new_workers, "restarts": restarts,
+                      "resumed_step": step, "resumed_from": resume}
+            continue
+        report.update(getattr(trainer, "_elastic_worker_stats", None)
+                      or {})
+        trainer._elastic_report = report
+        if restarts:
+            _log.info("elastic: fit completed after %d restart(s) on "
+                      "%d/%d workers (resumed from step %s)", restarts,
+                      report["workers"], initial,
+                      report.get("resumed_step"))
+        return result

@@ -1,0 +1,156 @@
+"""Deterministic fault injection for the elastic plane.
+
+Chaos testing a fault-tolerance subsystem needs *reproducible* faults:
+"kill rank 1 at step 5" must mean exactly that, every run, so the
+chaos tests (tests/test_failure.py) and the bench harness can assert on
+what happens after.  A :class:`FaultSpec` names one fault:
+
+- ``kill:rank=K,step=S[,code=C]`` — hard process exit (``os._exit``,
+  no exception, no teardown — the preemption model);
+- ``wedge:rank=K,step=S`` — the rank stops making progress WITHOUT
+  dying (sleeps forever; the connection stays open, so only the
+  heartbeat watchdog can name it);
+- ``slow:rank=K,step=S[,seconds=T]`` — the rank stalls ``T`` seconds
+  on every step from ``S`` on (a straggler, visible as skew in the
+  telemetry summary).
+
+:class:`FaultInjector` is a Callback armed with one spec; workers
+auto-install it when ``RLT_FAULT`` is set in their environment
+(``Trainer._run_stage``), so a test arms a fault with
+``cpu_plugin(2, worker_env={"RLT_FAULT": "kill:rank=1,step=5"})`` and
+nothing else.  kill/wedge take the whole process down — only arm them
+on actor workers (a local in-process fit would kill the driver).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+from typing import Optional
+
+from ray_lightning_tpu.core.callbacks import Callback
+
+_log = logging.getLogger(__name__)
+
+ENV_FAULT = "RLT_FAULT"
+
+VALID_KINDS = ("kill", "wedge", "slow")
+
+#: distinctive default exit code so a driver log line can tell an
+#: injected kill from a real crash
+DEFAULT_EXIT_CODE = 43
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One deterministic fault: ``kind`` at (``rank``, ``step``)."""
+
+    kind: str
+    rank: int
+    step: int
+    exit_code: int = DEFAULT_EXIT_CODE
+    seconds: float = 1.0
+
+    def __post_init__(self):
+        if self.kind not in VALID_KINDS:
+            raise ValueError(
+                f"fault kind {self.kind!r}; options: {VALID_KINDS}")
+        if self.rank < 0:
+            raise ValueError("fault rank must be >= 0")
+        if self.step < 1:
+            raise ValueError("fault step must be >= 1 (steps are "
+                             "counted post-increment)")
+        if self.seconds <= 0:
+            raise ValueError("fault seconds must be positive")
+
+    def should_fire(self, rank: int, step: int) -> bool:
+        """kill/wedge fire once at the first step >= ``step`` on the
+        target rank; slow fires on every such step."""
+        return rank == self.rank and step >= self.step
+
+    def describe(self) -> str:
+        extra = ""
+        if self.kind == "kill":
+            extra = f",code={self.exit_code}"
+        elif self.kind == "slow":
+            extra = f",seconds={self.seconds}"
+        return f"{self.kind}:rank={self.rank},step={self.step}{extra}"
+
+
+def parse_fault(spec: str) -> FaultSpec:
+    """``"kill:rank=1,step=5"`` → :class:`FaultSpec`.  Raises
+    ``ValueError`` on malformed input (the selfcheck pins this)."""
+    spec = spec.strip()
+    if ":" not in spec:
+        raise ValueError(
+            f"fault spec {spec!r} must look like "
+            f"'kill:rank=K,step=S' (kinds: {VALID_KINDS})")
+    kind, _, rest = spec.partition(":")
+    kw: dict = {}
+    for part in rest.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"fault spec field {part!r} is not key=value")
+        key, _, val = part.partition("=")
+        key = key.strip()
+        if key in ("rank", "step", "code", "exit_code"):
+            kw["exit_code" if key == "code" else key] = int(val)
+        elif key == "seconds":
+            kw["seconds"] = float(val)
+        else:
+            raise ValueError(f"unknown fault spec field {key!r}")
+    if "rank" not in kw or "step" not in kw:
+        raise ValueError(f"fault spec {spec!r} needs rank= and step=")
+    return FaultSpec(kind=kind.strip(), **kw)
+
+
+class FaultInjector(Callback):
+    """Callback arming one :class:`FaultSpec` against the live run."""
+
+    needs_batch = False   # fires on (rank, step) alone
+
+    def __init__(self, spec: FaultSpec):
+        self.spec = spec
+        self._fired = False
+
+    def on_train_batch_end(self, trainer, module, outputs, batch,
+                           batch_idx) -> None:
+        spec = self.spec
+        if not spec.should_fire(trainer.global_rank, trainer.global_step):
+            return
+        if spec.kind == "slow":
+            _log.warning("fault injector: slowing rank %d at step %d "
+                         "for %.2fs", spec.rank, trainer.global_step,
+                         spec.seconds)
+            time.sleep(spec.seconds)
+            return
+        if self._fired:
+            return
+        self._fired = True
+        if spec.kind == "kill":
+            _log.warning("fault injector: killing rank %d at step %d "
+                         "(exit %d)", spec.rank, trainer.global_step,
+                         spec.exit_code)
+            # flush the log line before the no-cleanup exit
+            logging.shutdown()
+            os._exit(spec.exit_code)
+        # wedge: stop making progress without dying — the connection
+        # stays open, so only the heartbeat watchdog can diagnose it
+        _log.warning("fault injector: wedging rank %d at step %d",
+                     spec.rank, trainer.global_step)
+        while True:
+            time.sleep(3600)
+
+
+def maybe_injector_from_env() -> Optional[FaultInjector]:
+    """The ``RLT_FAULT`` auto-install hook (``Trainer._run_stage``):
+    a malformed spec raises immediately — a chaos test whose fault never
+    arms must fail loudly, not pass vacuously."""
+    raw = os.environ.get(ENV_FAULT, "").strip()
+    if not raw:
+        return None
+    return FaultInjector(parse_fault(raw))

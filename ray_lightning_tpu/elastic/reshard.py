@@ -1,0 +1,270 @@
+"""Reshardable restore: load an orbax per-shard save onto a DIFFERENT
+topology than the one that wrote it.
+
+Most of the re-partitioning is free: orbax restores into whatever
+shardings the target avals carry, and every TrainState leaf's GLOBAL
+shape is topology-independent — params, optimizer moments, rng — so
+``abstract_like`` built from the NEW mesh + strategy is a valid restore
+target no matter who saved (DDP, ZeRO-1, FSDP, SPMD; the N→M host case
+and the strategy-swap case are the same operation).
+
+The exception is the comm plane's error-feedback residual
+(comm/collectives.py ``CommState``): its leaves are stacked
+``[world, *param_shape]`` where ``world`` is the SAVING run's
+data-parallel size.  Blindly reloading it under a different world
+either corrupts (orbax silently returns the saved shape when the
+target disagrees) or crashes at the first dispatch.  This module:
+
+1. reads the saved tree's shapes from orbax metadata (no array data);
+2. verifies every non-residual leaf's saved shape matches the target —
+   a mismatch raises naming the leaf instead of silently restoring the
+   wrong shape;
+3. restores the residual at its SAVED shape and re-buckets it N→M:
+   ``new_r[j] = mean_i(old_r[i])`` for every new rank j.  The quantity
+   error feedback actually injects into the model is
+   ``(1/world)·Σ_i r_i`` (GradSync.sync adds each rank's slice before
+   the mean-reduction), and the mean-broadcast preserves it exactly:
+   ``(1/M)·Σ_j mean_i(old_r) = (1/N)·Σ_i old_r``.  What is NOT
+   preserved is the per-rank attribution of the error — documented
+   tolerance: the first post-restore step quantizes slightly different
+   per-rank payloads than an uninterrupted run would have.
+4. bridges structure changes: a save with a residual restored into a
+   comm-off run drops it (losing one pending correction — logged); a
+   comm-off save restored into a comm-on run keeps the target's zero
+   residual and restores only the inner optimizer state.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_lightning_tpu.utils.checkpoint import abstract_like
+
+_log = logging.getLogger(__name__)
+
+
+def _md_array_leaves(node) -> list:
+    """Array-metadata leaves under one orbax metadata subtree (plain
+    nested dicts/lists keyed the way jax key-paths stringify; empty
+    pytree nodes appear as ``None``)."""
+    out: list = []
+    if node is None:
+        return out
+    if isinstance(node, dict):
+        for v in node.values():
+            out.extend(_md_array_leaves(v))
+        return out
+    if isinstance(node, (list, tuple)):
+        for v in node:
+            out.extend(_md_array_leaves(v))
+        return out
+    if hasattr(node, "shape"):
+        out.append(node)
+    return out
+
+
+def _md_paths(node, prefix: tuple = ()) -> dict:
+    """{path tuple of str: shape} for every array leaf in the saved
+    metadata tree."""
+    out: dict = {}
+    if node is None:
+        return out
+    if isinstance(node, dict):
+        for k, v in node.items():
+            out.update(_md_paths(v, prefix + (str(k),)))
+        return out
+    if isinstance(node, (list, tuple)):
+        for i, v in enumerate(node):
+            out.update(_md_paths(v, prefix + (str(i),)))
+        return out
+    if hasattr(node, "shape"):
+        out[prefix] = tuple(node.shape)
+    return out
+
+
+def _key_str(entry) -> str:
+    """One jax KeyPath entry → the string orbax names it with."""
+    for attr in ("name", "key", "idx"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def _target_paths(tree) -> dict:
+    """{path tuple of str: aval} for every leaf of the restore target
+    (same naming as :func:`_md_paths` so the two are comparable)."""
+    out: dict = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        out[tuple(_key_str(p) for p in path)] = leaf
+    return out
+
+
+def saved_residual_world(md: Optional[dict]) -> Optional[int]:
+    """The saved ``CommState`` residual's stacked world size, or None
+    when the save carries no residual arrays (comm off, or EF off)."""
+    if not isinstance(md, dict):
+        return None
+    opt = md.get("opt_state")
+    if not isinstance(opt, dict):
+        return None
+    leaves = _md_array_leaves(opt.get("residual"))
+    if not leaves:
+        return None
+    return int(leaves[0].shape[0])
+
+
+def _saved_is_commstate(md: Optional[dict]) -> bool:
+    if not isinstance(md, dict):
+        return False
+    opt = md.get("opt_state")
+    return isinstance(opt, dict) and "residual" in opt and "inner" in opt
+
+
+def _mesh_of(shardings) -> Any:
+    for leaf in jax.tree_util.tree_leaves(
+            shardings, is_leaf=lambda x: hasattr(x, "mesh")):
+        if hasattr(leaf, "mesh"):
+            return leaf.mesh
+    raise ValueError("no NamedSharding leaf in the shardings tree")
+
+
+def _rebucket(old: Any, new_world: int, target_shardings: Any) -> Any:
+    """``[N, ...]`` residual tree → ``[M, ...]``: mean over the old
+    world axis broadcast to every new rank (sum-of-injected-error
+    preserving — module docstring), placed per the target shardings."""
+    def leaf(r, sh):
+        m = jnp.mean(jnp.asarray(r, jnp.float32), axis=0, keepdims=True)
+        out = jnp.broadcast_to(m, (new_world,) + tuple(m.shape[1:]))
+        return jax.device_put(out, sh)
+    return jax.tree_util.tree_map(leaf, old, target_shardings)
+
+
+def restore_resharded(ckpt, state_like: Any, shardings: Any,
+                      step: Optional[int] = None) -> tuple:
+    """Restore ``(state, meta)`` from ``ckpt`` into the CURRENT
+    topology described by ``(state_like, shardings)``.
+
+    ``ckpt`` is a :class:`~ray_lightning_tpu.utils.checkpoint.\
+ShardedCheckpointer`; ``state_like`` is the live (freshly initialized)
+    TrainState whose structure/shapes describe the restore target.
+    Handles the ``CommState`` residual world change and comm-on/off
+    structure bridging; any OTHER saved-vs-target shape divergence
+    raises naming the leaf.
+    """
+    from ray_lightning_tpu.comm.collectives import CommState
+
+    if step is None:
+        step = ckpt.latest_step()
+    abstract = abstract_like(state_like, shardings)
+    md = ckpt.saved_state_metadata(step)
+    if md is None:
+        # metadata unavailable (very old save / remote backend quirk):
+        # fall back to the plain same-topology restore
+        return ckpt.restore(abstract, step=step)
+
+    cur_opt = abstract.opt_state
+    cur_is_comm = isinstance(cur_opt, CommState)
+    cur_res_leaves = (jax.tree_util.tree_leaves(cur_opt.residual)
+                      if cur_is_comm else [])
+    cur_world = (int(cur_res_leaves[0].shape[0])
+                 if cur_res_leaves else None)
+    saved_world = saved_residual_world(md)
+    saved_is_comm = _saved_is_commstate(md)
+    mesh = _mesh_of(shardings)
+
+    target = abstract
+    fix = None   # post-restore adapter
+    if saved_world is not None and cur_world is not None:
+        if saved_world != cur_world:
+            # case A: both runs carry a residual, worlds differ —
+            # restore at the SAVED shape (replicated: the old
+            # partitioning is gone), then re-bucket N→M
+            res_avals = jax.tree_util.tree_map(
+                lambda r: jax.ShapeDtypeStruct(
+                    (saved_world,) + tuple(r.shape[1:]), r.dtype,
+                    sharding=NamedSharding(mesh, P())),
+                cur_opt.residual)
+            target = abstract.replace(opt_state=CommState(
+                residual=res_avals, inner=cur_opt.inner))
+
+            def fix(state):
+                _log.info(
+                    "elastic reshard: re-bucketing error-feedback "
+                    "residual [%d, ...] -> [%d, ...] (mean-broadcast; "
+                    "total pending correction preserved)",
+                    saved_world, cur_world)
+                res = _rebucket(state.opt_state.residual, cur_world,
+                                shardings.opt_state.residual)
+                return state.replace(opt_state=CommState(
+                    residual=res, inner=state.opt_state.inner))
+    elif saved_world is not None and cur_world is None:
+        # case B: the save carries a residual this run does not use
+        # (comm/EF off now, or world shrank to 1).  Restore it at the
+        # saved shape just to reach the inner state, then drop it.
+        res_avals = jax.tree_util.tree_map(
+            lambda node: jax.ShapeDtypeStruct(
+                tuple(node.shape), node.dtype,
+                sharding=NamedSharding(mesh, P())),
+            md["opt_state"]["residual"],
+            is_leaf=lambda n: hasattr(n, "shape"))
+        target = abstract.replace(opt_state=CommState(
+            residual=res_avals,
+            inner=cur_opt.inner if cur_is_comm else cur_opt))
+
+        def fix(state):
+            _log.warning(
+                "elastic reshard: dropping the saved [%d, ...] "
+                "error-feedback residual (the restored run carries "
+                "none) — one pending quantization correction is lost",
+                saved_world)
+            inner = state.opt_state.inner
+            new_opt = (CommState(residual=state_like.opt_state.residual,
+                                 inner=inner) if cur_is_comm else inner)
+            return state.replace(opt_state=new_opt)
+    elif saved_world is None and cur_world is not None:
+        # case C: comm-off (or EF-off) save restored into a comm-on
+        # run — restore only the inner state; error feedback restarts
+        # from the target's zero residual.
+        target = abstract.replace(
+            opt_state=CommState(residual=(), inner=cur_opt.inner)
+            if saved_is_comm else cur_opt.inner)
+
+        def fix(state):
+            inner = (state.opt_state.inner
+                     if isinstance(state.opt_state, CommState)
+                     else state.opt_state)
+            return state.replace(opt_state=CommState(
+                residual=state_like.opt_state.residual, inner=inner))
+
+    _verify_shapes(md, target)
+    state, meta = ckpt.restore(target, step=step)
+    if fix is not None:
+        state = fix(state)
+    return state, meta
+
+
+def _verify_shapes(md: dict, target: Any) -> None:
+    """Every target leaf must exist in the save with the same global
+    shape — a divergence would otherwise restore silently wrong (orbax
+    returns the SAVED shape when the target disagrees)."""
+    saved = _md_paths(md)
+    want = _target_paths(target)
+    problems = []
+    for path, aval in want.items():
+        got = saved.get(path)
+        if got is None:
+            problems.append(
+                f"{'/'.join(path)}: missing from the checkpoint")
+        elif tuple(got) != tuple(aval.shape):
+            problems.append(
+                f"{'/'.join(path)}: saved shape {tuple(got)} != "
+                f"target {tuple(aval.shape)}")
+    if problems:
+        raise ValueError(
+            "checkpoint does not reshard onto this topology:\n  "
+            + "\n  ".join(problems))

@@ -1,0 +1,114 @@
+"""Elastic-plane selfcheck for ``format.sh --check`` (CI gate).
+
+Same contract as the comm/compile/serve selfchecks: cheap,
+deterministic, no pytest — validates the invariants that would
+otherwise only fail deep inside a shrinking fleet:
+
+1. ``ElasticConfig`` validation + ``RLT_ELASTIC*`` env round-trip
+   (``worker_env`` → ``resolve`` reproduces the config);
+2. fault-spec parsing (every kind round-trips; malformed specs raise);
+3. every elastic metric name is Prometheus-clean (the PR 2 lint);
+4. the residual re-bucket preserves the injected-error invariant
+   ``(1/M)·Σ new = (1/N)·Σ old`` on a small CPU array.
+"""
+
+from __future__ import annotations
+
+
+def _check_config() -> None:
+    import os
+    from ray_lightning_tpu.elastic.config import ElasticConfig
+
+    cfg = ElasticConfig(enabled=True, snapshot_every_n_steps=25,
+                        snapshot_dir="/tmp/ck", max_restarts=3,
+                        min_workers=2, preserve_global_batch=False,
+                        max_to_keep=5)
+    saved = {k: os.environ.get(k) for k in list(os.environ)
+             if k.startswith("RLT_ELASTIC")}
+    try:
+        for k in saved:
+            os.environ.pop(k, None)
+        os.environ.update(cfg.worker_env())
+        assert ElasticConfig.resolve(None) == cfg, "env round-trip drifted"
+    finally:
+        for k in list(os.environ):
+            if k.startswith("RLT_ELASTIC"):
+                os.environ.pop(k, None)
+        os.environ.update({k: v for k, v in saved.items() if v is not None})
+    assert not ElasticConfig.resolve(None).enabled
+    assert ElasticConfig.resolve({"snapshot_every_n_steps": 5}).enabled
+    for bad in (dict(snapshot_every_n_steps=-1), dict(min_workers=0),
+                dict(max_restarts=-1), dict(max_to_keep=0)):
+        try:
+            ElasticConfig(enabled=True, **bad)
+        except ValueError:
+            pass
+        else:
+            raise AssertionError(f"expected ValueError for {bad}")
+    print("elastic selfcheck: config validation + env round-trip OK")
+
+
+def _check_faults() -> None:
+    from ray_lightning_tpu.elastic.faults import FaultSpec, parse_fault
+
+    s = parse_fault("kill:rank=1,step=5,code=9")
+    assert s == FaultSpec("kill", 1, 5, exit_code=9)
+    assert s.should_fire(1, 5) and s.should_fire(1, 6)
+    assert not s.should_fire(0, 5) and not s.should_fire(1, 4)
+    assert parse_fault("wedge:rank=0,step=2").kind == "wedge"
+    slow = parse_fault("slow:rank=2,step=3,seconds=0.5")
+    assert slow.seconds == 0.5
+    assert parse_fault(s.describe()) == s   # describe round-trips
+    for bad in ("kill", "boom:rank=1,step=2", "kill:rank=1",
+                "kill:rank=1,step=0", "kill:rank=-1,step=2",
+                "kill:rank=1;step=2"):
+        try:
+            parse_fault(bad)
+        except ValueError:
+            pass
+        else:
+            raise AssertionError(f"expected ValueError for {bad!r}")
+    print("elastic selfcheck: fault-spec parsing OK")
+
+
+def _check_metric_names() -> None:
+    from ray_lightning_tpu.telemetry.metrics import validate_metric_name
+    for name in ("rlt_snapshot_total", "rlt_snapshot_skipped_total",
+                 "rlt_snapshot_seconds_total",
+                 "rlt_snapshot_stall_seconds_total",
+                 "rlt_restarts_total", "rlt_worker_alive"):
+        validate_metric_name(name)
+    print("elastic selfcheck: metric names Prometheus-clean")
+
+
+def _check_rebucket() -> None:
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax
+    from ray_lightning_tpu.elastic.reshard import _rebucket
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("data",))
+    rep = NamedSharding(mesh, P())
+    old = {"w": np.arange(12, dtype=np.float32).reshape(3, 4)}
+    for m in (1, 2, 6):
+        new = _rebucket(old, m, {"w": rep})
+        got = np.asarray(new["w"])
+        assert got.shape == (m, 4)
+        # injected-correction invariant: (1/M)·Σ new == (1/N)·Σ old
+        np.testing.assert_allclose(
+            got.sum(0) / m, old["w"].sum(0) / 3, rtol=1e-6)
+    print("elastic selfcheck: residual re-bucket preserves the "
+          "injected-error sum")
+
+
+def _main(argv: list) -> int:
+    _check_config()
+    _check_faults()
+    _check_metric_names()
+    _check_rebucket()
+    return 0
+
+
+if __name__ == "__main__":   # pragma: no cover - exercised via format.sh
+    import sys
+    sys.exit(_main(sys.argv[1:]))

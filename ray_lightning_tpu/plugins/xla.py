@@ -131,6 +131,9 @@ def _worker_run(payload: tuple, rank: int, queue,
         # Weights return in-band as a state stream — PL's temp-file
         # handoff breaks multi-node (rationale at ray_ddp.py:480-486).
         package["state_stream"] = to_state_stream(module._trained_variables)
+        # elastic-plane numbers (snapshot counters etc.) for the
+        # driver's _elastic_report / bench JSON
+        package["elastic"] = trainer.elastic_stats()
         ckpt_cb = trainer.checkpoint_callback
         if ckpt_cb is not None:
             package["best_model_path"] = ckpt_cb.best_model_path
@@ -274,6 +277,24 @@ class RayXlaPlugin(ExecutionPlugin):
             ckpt_path: Optional[str]):
         if self._is_remote:
             raise RuntimeError("plugin.run called inside a worker")
+        elastic = getattr(trainer, "elastic", None)
+        if stage == "fit" and elastic is not None and elastic.enabled \
+                and elastic.max_restarts > 0:
+            # shrink-to-continue: a dead rank tears the fleet down, the
+            # elastic driver rebuilds it with the survivors and resumes
+            # from the latest snapshot (elastic/driver.py)
+            from ray_lightning_tpu.elastic.driver import run_elastic_fit
+            return run_elastic_fit(self, trainer, module, datamodule,
+                                   ckpt_path)
+        return self._run_attempt(trainer, module, datamodule, stage,
+                                 ckpt_path)
+
+    def _run_attempt(self, trainer, module, datamodule, stage: str,
+                     ckpt_path: Optional[str]):
+        """One fleet lifecycle: create actors, rendezvous, execute,
+        tear down.  The elastic driver calls this repeatedly with a
+        shrinking ``num_workers``; everything per-fleet (actors,
+        aggregator, metrics server) is rebuilt per attempt."""
         backend = get_backend()
         self._backend = backend
         base_env = self._worker_env_base()
@@ -293,6 +314,8 @@ class RayXlaPlugin(ExecutionPlugin):
         # the resolved CommPolicy; the env keeps worker-side tooling that
         # consults RLT_COMM* (e.g. a nested fit) consistent with it
         base_env.update(trainer.comm_policy.worker_env())
+        # elastic knobs too (RLT_ELASTIC* — elastic/config.py)
+        base_env.update(trainer.elastic.worker_env())
         from ray_lightning_tpu.core import datacheck
         if datacheck.enabled():
             # driver-set RLT_DATA_CHECK=1 reaches workers explicitly
@@ -322,6 +345,9 @@ class RayXlaPlugin(ExecutionPlugin):
                 cfg.resolve_dir(trainer.default_root_dir),
                 heartbeat_timeout=cfg.heartbeat_timeout,
                 hard_timeout=cfg.hard_timeout)
+            # elastic restart count survives the per-attempt aggregator
+            # rebuild so /metrics' rlt_restarts_total is cumulative
+            agg.set_restarts(getattr(self, "_elastic_restarts", 0))
             for i, w in enumerate(self._workers):
                 agg.register_worker(i, w)
             telemetry.set_active(agg)
@@ -343,6 +369,17 @@ class RayXlaPlugin(ExecutionPlugin):
         try:
             return self._execution_loop(trainer, module, datamodule, stage,
                                         ckpt_path, backend)
+        except BaseException:
+            # probe fleet liveness BEFORE teardown kills everyone: the
+            # elastic driver classifies the failure (a dead process is
+            # restartable, a deterministic user exception is not) and
+            # sizes the shrink from this list.  process_alive, not
+            # alive: the strict probe never misreads a busy survivor
+            # as dead (cluster/backend.py)
+            self._last_dead_ranks = [
+                i for i, w in enumerate(self._workers)
+                if w.process_alive() is False]
+            raise
         finally:
             if dc is not None:
                 datacheck.set_active_validator(None)
@@ -487,6 +524,7 @@ class RayXlaPlugin(ExecutionPlugin):
         trainer.current_epoch = rank0.get("epoch", trainer.current_epoch)
         trainer.global_step = rank0.get("global_step", trainer.global_step)
         trainer.time_to_first_step = rank0.get("time_to_first_step")
+        trainer._elastic_worker_stats = rank0.get("elastic")
         if stage == "fit":
             stream = rank0.get("state_stream")
             if stream is not None:

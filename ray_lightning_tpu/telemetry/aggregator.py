@@ -101,6 +101,12 @@ class TelemetryAggregator:
         self._metric_windows_dropped = 0
         self._metrics_latest: dict[int, dict] = {}
         self._metrics_first_ts: dict[int, float] = {}
+        #: elastic plane: per-rank liveness verdicts + the cumulative
+        #: shrink-to-continue restart count, exported as driver-side
+        #: (rank -1) series so /metrics shows FLEET health, not just
+        #: driver-log text (rlt_worker_alive / rlt_restarts_total)
+        self._fleet_alive: dict[int, int] = {}
+        self._restarts = 0
 
     # -- ingestion -------------------------------------------------------
 
@@ -135,9 +141,71 @@ class TelemetryAggregator:
                 rank, item.get("ts", time.time()))
 
     def latest_metrics(self) -> dict[int, dict]:
-        """rank -> latest cumulative metrics window (exporter surface)."""
+        """rank -> latest cumulative metrics window (exporter surface).
+        A synthetic rank ``-1`` window carries the driver's own series
+        (fleet liveness, restart count) when any exist — merged with an
+        ingested rank ``-1`` window (the serve plane's driver registry)
+        rather than clobbering it."""
         with self._lock:
-            return dict(self._metrics_latest)
+            out = dict(self._metrics_latest)
+        drv = self._driver_metrics()
+        if drv:
+            base = out.get(-1)
+            out[-1] = {
+                TELEMETRY_KEY: 1, "kind": "metrics", "rank": -1,
+                "ts": time.time(),
+                "metrics": (list(base.get("metrics", ()))
+                            if base else []) + drv,
+            }
+        return out
+
+    # -- fleet health (elastic plane) ------------------------------------
+
+    def set_restarts(self, n: int) -> None:
+        """Cumulative shrink-to-continue restart count — set by the
+        plugin on every attempt so the counter survives the per-attempt
+        aggregator rebuild (elastic/driver.py)."""
+        with self._lock:
+            self._restarts = int(n)
+
+    def note_worker_alive(self, rank: int, alive: bool) -> None:
+        with self._lock:
+            self._fleet_alive[rank] = 1 if alive else 0
+
+    def _update_fleet_health(self, now: float) -> None:
+        """Refresh the per-rank liveness gauges: the backend's process
+        probe when it can answer, heartbeat age otherwise."""
+        with self._lock:
+            handles = dict(self._workers)
+            beats = {b["beat"].get("rank", -1): now - b["at"]
+                     for b in self._hb.values()}
+        for rank, handle in handles.items():
+            alive = getattr(handle, "alive", lambda: None)() \
+                if handle is not None else None
+            if alive is None:
+                age = beats.get(rank)
+                if age is None:
+                    continue   # never beat, nothing to say yet
+                alive = age <= self.heartbeat_timeout
+            self.note_worker_alive(rank, bool(alive))
+
+    def _driver_metrics(self) -> list[dict]:
+        with self._lock:
+            fleet = dict(self._fleet_alive)
+            restarts = self._restarts
+        if not fleet and not restarts:
+            return []
+        out = [{"name": "rlt_worker_alive", "type": "gauge",
+                "labels": {"worker": str(rank)}, "value": v}
+               for rank, v in sorted(fleet.items())]
+        out.append({"name": "rlt_restarts_total", "type": "counter",
+                    "labels": {}, "value": restarts})
+        return out
+
+    def fleet_health(self) -> dict[int, int]:
+        """rank -> 1/0 liveness verdict (tests/status surface)."""
+        with self._lock:
+            return dict(self._fleet_alive)
 
     def ingest_records(self, rank: int, records: list[dict]) -> None:
         for r in records:
@@ -222,6 +290,7 @@ class TelemetryAggregator:
         raise once past ``hard_timeout`` when configured, so a wedged
         collective cannot hang the driver forever)."""
         now = self._clock()
+        self._update_fleet_health(now)
         with self._lock:
             snapshot = [(k, v["at"], v["beat"]) for k, v in self._hb.items()]
         for key, at, beat in snapshot:

@@ -52,6 +52,11 @@ _log = logging.getLogger(__name__)
 NAME_RE = re.compile(r"^rlt_[a-z0-9_]+$")
 UNIT_SUFFIXES = ("_bytes", "_seconds", "_total")
 
+#: unitless boolean gauges (Prometheus "up"-style) explicitly exempt
+#: from the unit-suffix rule — a 0/1 liveness verdict has no unit to
+#: carry.  Keep this list short and deliberate.
+UNITLESS_GAUGES = ("rlt_worker_alive",)
+
 #: step-time histogram bounds (seconds): sub-ms dispatch latency up to
 #: multi-second giant-model steps
 STEP_TIME_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
@@ -75,6 +80,14 @@ CORE_METRICS = (
     "rlt_collective_seconds_total",
     "rlt_data_wait_seconds_total",
     "rlt_telemetry_dropped_total",
+    # elastic plane (elastic/snapshot.py + the driver-side fleet
+    # health series the aggregator synthesizes)
+    "rlt_snapshot_total",
+    "rlt_snapshot_skipped_total",
+    "rlt_snapshot_seconds_total",
+    "rlt_snapshot_stall_seconds_total",
+    "rlt_restarts_total",
+    "rlt_worker_alive",
 )
 
 
@@ -84,10 +97,11 @@ def validate_metric_name(name: str) -> str:
     if not NAME_RE.match(name):
         raise ValueError(
             f"metric name {name!r} must match {NAME_RE.pattern}")
-    if not name.endswith(UNIT_SUFFIXES):
+    if not name.endswith(UNIT_SUFFIXES) and name not in UNITLESS_GAUGES:
         raise ValueError(
             f"metric name {name!r} must end with a unit suffix "
-            f"{UNIT_SUFFIXES}")
+            f"{UNIT_SUFFIXES} (or be a declared unitless boolean "
+            f"gauge: {UNITLESS_GAUGES})")
     return name
 
 

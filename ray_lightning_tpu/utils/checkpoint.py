@@ -39,7 +39,15 @@ def _manager(directory: str, async_save: bool, max_to_keep: Optional[int]):
         max_to_keep=max_to_keep,
         enable_async_checkpointing=async_save,
     )
-    return ocp.CheckpointManager(directory, options=options)
+    # item names/handlers declared up front: a FRESH manager over an
+    # existing directory can then answer item_metadata() (the elastic
+    # reshard path reads saved shapes before restoring) and restore a
+    # subset of items, instead of failing handler inference
+    return ocp.CheckpointManager(
+        directory, options=options,
+        item_names=("state", "meta"),
+        item_handlers={"state": ocp.StandardCheckpointHandler(),
+                       "meta": ocp.JsonCheckpointHandler()})
 
 
 def abstract_like(state: Any, shardings: Any) -> Any:
@@ -87,6 +95,19 @@ class ShardedCheckpointer:
         with span("checkpoint_wait"):
             self._mgr.wait_until_finished()
 
+    def saving_in_progress(self) -> bool:
+        """True while a previous async save is still writing — the
+        elastic snapshotter's backpressure probe (elastic/snapshot.py).
+        Conservatively False on orbax builds without the query (a save
+        then simply blocks inside orbax instead of being skipped)."""
+        probe = getattr(self._mgr, "is_saving_in_progress", None)
+        if probe is None:
+            return False
+        try:
+            return bool(probe())
+        except Exception:
+            return False
+
     # -- restore ---------------------------------------------------------
 
     def latest_step(self) -> Optional[int]:
@@ -94,6 +115,22 @@ class ShardedCheckpointer:
 
     def all_steps(self) -> list[int]:
         return sorted(self._mgr.all_steps())
+
+    def saved_state_metadata(self, step: Optional[int] = None):
+        """Shapes/dtypes of the SAVED ``state`` tree (a nested dict of
+        array metadata, no array data read) — what the elastic reshard
+        path compares the restore target against so a topology change
+        never restores silently wrong (elastic/reshard.py).  ``None``
+        when the manager cannot answer (old orbax, remote quirk)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        try:
+            md = self._mgr.item_metadata(int(step))
+            return getattr(md, "state", None)
+        except Exception:
+            return None
 
     def restore(self, abstract_state: Any,
                 step: Optional[int] = None) -> tuple[Any, dict]:

@@ -1,0 +1,283 @@
+"""Elastic plane units: config resolution, fault specs, snapshot
+cadence + backpressure, loader rescale, fleet-health metrics, and the
+failure classifier (ray_lightning_tpu/elastic/).
+
+The end-to-end legs live elsewhere: the 2-worker chaos run in
+tests/test_failure.py, the N→M restore equality in
+tests/test_sharded_checkpoint.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from ray_lightning_tpu import DataLoader, ElasticConfig, Trainer
+from ray_lightning_tpu.elastic.driver import (_restartable,
+                                              latest_snapshot_step)
+from ray_lightning_tpu.elastic.faults import (FaultSpec, parse_fault)
+from ray_lightning_tpu.models import BoringModel
+from ray_lightning_tpu.models.boring import RandomDataset
+from ray_lightning_tpu.telemetry.aggregator import (TelemetryAggregator,
+                                                    WorkerHeartbeatTimeout)
+from ray_lightning_tpu.telemetry.exporter import render_prometheus
+from ray_lightning_tpu.utils.checkpoint import ShardedCheckpointer
+
+
+# -- config ---------------------------------------------------------------
+
+def test_elastic_config_resolve_env(monkeypatch):
+    monkeypatch.setenv("RLT_ELASTIC", "1")
+    monkeypatch.setenv("RLT_ELASTIC_EVERY", "25")
+    monkeypatch.setenv("RLT_ELASTIC_DIR", "/tmp/snaps")
+    monkeypatch.setenv("RLT_ELASTIC_MAX_RESTARTS", "5")
+    monkeypatch.setenv("RLT_ELASTIC_MIN_WORKERS", "2")
+    monkeypatch.setenv("RLT_ELASTIC_KEEP", "7")
+    monkeypatch.setenv("RLT_ELASTIC_PRESERVE_BATCH", "0")
+    cfg = ElasticConfig.resolve(None)
+    assert cfg == ElasticConfig(
+        enabled=True, snapshot_every_n_steps=25, snapshot_dir="/tmp/snaps",
+        max_restarts=5, min_workers=2, preserve_global_batch=False,
+        max_to_keep=7)
+    # worker_env -> resolve round-trips (the RLT_COMM* contract)
+    for k in list(os.environ):
+        if k.startswith("RLT_ELASTIC"):
+            monkeypatch.delenv(k)
+    for k, v in cfg.worker_env().items():
+        monkeypatch.setenv(k, v)
+    assert ElasticConfig.resolve(None) == cfg
+
+
+def test_elastic_config_forms():
+    assert not ElasticConfig.resolve(None).enabled   # default off
+    assert ElasticConfig.resolve(True).enabled
+    cfg = ElasticConfig.resolve({"snapshot_every_n_steps": 4})
+    assert cfg.enabled and cfg.snapshot_every_n_steps == 4
+    with pytest.raises(ValueError):
+        ElasticConfig(enabled=True, min_workers=0)
+    with pytest.raises(TypeError):
+        ElasticConfig.resolve(3.14)
+    assert ElasticConfig().resolve_dir("/root/x") == "/root/x/elastic"
+
+
+# -- fault specs ----------------------------------------------------------
+
+def test_fault_spec_parsing():
+    s = parse_fault("kill:rank=1,step=5")
+    assert s == FaultSpec("kill", 1, 5)
+    assert s.should_fire(1, 5) and s.should_fire(1, 9)
+    assert not s.should_fire(0, 5) and not s.should_fire(1, 4)
+    assert parse_fault("slow:rank=0,step=2,seconds=0.25").seconds == 0.25
+    assert parse_fault("kill:rank=2,step=3,code=9").exit_code == 9
+    assert parse_fault(s.describe()) == s
+    for bad in ("", "kill", "boom:rank=1,step=2", "kill:step=2",
+                "kill:rank=1,step=0", "kill:rank=1,step=2,what=3"):
+        with pytest.raises(ValueError):
+            parse_fault(bad)
+
+
+def test_slow_fault_injects_stall(tmp_path, seed):
+    """The slow-rank fault measurably stalls the run (the straggler
+    harness) without changing its result."""
+    import time
+    t0 = time.monotonic()
+    trainer = Trainer(
+        max_epochs=1, max_steps=3, enable_checkpointing=False,
+        num_sanity_val_steps=0, limit_val_batches=0, seed=0,
+        log_every_n_steps=1, default_root_dir=str(tmp_path))
+    os.environ["RLT_FAULT"] = "slow:rank=0,step=2,seconds=0.2"
+    try:
+        trainer.fit(BoringModel())
+    finally:
+        os.environ.pop("RLT_FAULT", None)
+    assert trainer.global_step == 3
+    assert time.monotonic() - t0 >= 0.4   # steps 2 and 3 each stalled
+
+
+# -- snapshotting ---------------------------------------------------------
+
+def test_snapshot_cadence_and_stats(tmp_path, seed):
+    snap = str(tmp_path / "elastic")
+    trainer = Trainer(
+        max_epochs=10, max_steps=6, enable_checkpointing=False,
+        num_sanity_val_steps=0, limit_val_batches=0, seed=0,
+        log_every_n_steps=1, default_root_dir=str(tmp_path),
+        elastic={"snapshot_every_n_steps": 2, "snapshot_dir": snap})
+    trainer.fit(BoringModel())
+    stats = trainer.elastic_stats()
+    assert stats["snapshots"] + stats["skipped"] == 3   # steps 2, 4, 6
+    assert stats["snapshots"] >= 1
+    trainer.wait_for_checkpoints()
+    ck = ShardedCheckpointer(snap)
+    steps = ck.all_steps()
+    ck.close()
+    assert steps and all(s in (2, 4, 6) for s in steps)
+    assert latest_snapshot_step(snap) == steps[-1]
+
+
+def test_snapshot_backpressure_skips_never_queues(tmp_path, seed,
+                                                 monkeypatch):
+    """While a previous save is still writing, a single-process cadence
+    hit is SKIPPED (bounded backpressure), not queued."""
+    monkeypatch.setattr(ShardedCheckpointer, "saving_in_progress",
+                        lambda self: True)
+    snap = str(tmp_path / "elastic")
+    trainer = Trainer(
+        max_epochs=10, max_steps=4, enable_checkpointing=False,
+        num_sanity_val_steps=0, limit_val_batches=0, seed=0,
+        log_every_n_steps=1, default_root_dir=str(tmp_path),
+        elastic={"snapshot_every_n_steps": 1, "snapshot_dir": snap})
+    trainer.fit(BoringModel())
+    stats = trainer.elastic_stats()
+    assert stats["snapshots"] == 0
+    assert stats["skipped"] == 4
+    assert stats["stall_seconds"] == 0.0
+
+
+def test_elastic_off_keeps_trainer_inert(tmp_path, seed):
+    trainer = Trainer(
+        max_epochs=1, max_steps=2, enable_checkpointing=False,
+        num_sanity_val_steps=0, limit_val_batches=0, seed=0,
+        log_every_n_steps=1, default_root_dir=str(tmp_path))
+    trainer.fit(BoringModel())
+    assert trainer._snapshotter is None
+    assert trainer.elastic_stats() is None
+    assert not (tmp_path / "elastic").exists()
+
+
+# -- shrink plumbing ------------------------------------------------------
+
+def test_elastic_rescale_preserves_global_batch(tmp_path):
+    trainer = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        default_root_dir=str(tmp_path),
+        elastic={"snapshot_every_n_steps": 0})
+    trainer._elastic_state = {"initial_workers": 4}
+    trainer._world = {"world_size": 2, "global_rank": 0, "local_rank": 0,
+                     "node_rank": 0}
+    loader = DataLoader(RandomDataset(32, 64), batch_size=3)
+    out = trainer._elastic_rescale_loader(loader, "train")
+    assert out.batch_size == 6          # 4 workers x 3 == 2 workers x 6
+    assert out.dataset is loader.dataset
+    assert loader.batch_size == 3       # original untouched
+
+    # non-dividing global batch: warn and keep the per-worker size
+    trainer._world["world_size"] = 5
+    same = trainer._elastic_rescale_loader(loader, "train")
+    assert same.batch_size == 3
+
+    # no shrink -> no-op (the common, attempt-1 case)
+    trainer._world["world_size"] = 4
+    assert trainer._elastic_rescale_loader(loader, "train") is loader
+
+
+def test_failure_classifier():
+    assert _restartable(RuntimeError("anything"), dead_ranks=[1])
+    assert _restartable(WorkerHeartbeatTimeout("rank 1 silent"), [])
+    assert _restartable(RuntimeError(
+        "actor rlt-worker-1 died (connection lost)"), [])
+    # a deterministic user exception must propagate, not retry
+    assert not _restartable(RuntimeError("ValueError in training_step"),
+                            [])
+
+
+def test_latest_snapshot_step_missing_dir(tmp_path):
+    assert latest_snapshot_step(str(tmp_path / "nope")) is None
+
+
+# -- fleet health on /metrics (satellite: watchdog verdicts become
+#    metrics) -------------------------------------------------------------
+
+class _FakeHandle:
+    def __init__(self, alive):
+        self._alive = alive
+
+    def alive(self):
+        return self._alive
+
+
+def test_worker_alive_gauges_and_restarts_counter(tmp_path):
+    clock = [100.0]
+    agg = TelemetryAggregator(str(tmp_path), heartbeat_timeout=5.0,
+                              clock=lambda: clock[0])
+    agg.register_worker(0, _FakeHandle(True))
+    agg.register_worker(1, _FakeHandle(False))
+    agg.set_restarts(2)
+    agg.watchdog_check()
+    assert agg.fleet_health() == {0: 1, 1: 0}
+
+    latest = agg.latest_metrics()
+    assert -1 in latest
+    series = {(m["name"], m["labels"].get("worker")): m["value"]
+              for m in latest[-1]["metrics"]}
+    assert series[("rlt_worker_alive", "0")] == 1
+    assert series[("rlt_worker_alive", "1")] == 0
+    assert series[("rlt_restarts_total", None)] == 2
+
+    text = render_prometheus(agg)
+    assert 'rlt_worker_alive{rank="-1",worker="1"} 0' in text
+    assert 'rlt_restarts_total{rank="-1"} 2' in text
+
+
+def test_worker_alive_falls_back_to_heartbeat_age(tmp_path):
+    """Backends whose probe cannot answer (alive() is None) derive the
+    verdict from heartbeat age."""
+    clock = [100.0]
+    agg = TelemetryAggregator(str(tmp_path), heartbeat_timeout=5.0,
+                              clock=lambda: clock[0])
+    agg.register_worker(0, _FakeHandle(None))
+    agg.register_worker(1, _FakeHandle(None))
+    for rank, pid in ((0, 11), (1, 22)):
+        agg.maybe_ingest({"__rlt_telemetry__": 1, "kind": "heartbeat",
+                          "rank": rank, "pid": pid, "wall": 0.0})
+    agg.watchdog_check()
+    assert agg.fleet_health() == {0: 1, 1: 1}
+    # rank 1 goes silent past the timeout; rank 0 keeps beating
+    clock[0] = 110.0
+    agg.maybe_ingest({"__rlt_telemetry__": 1, "kind": "heartbeat",
+                      "rank": 0, "pid": 11, "wall": 0.0})
+    agg.watchdog_check()
+    assert agg.fleet_health() == {0: 1, 1: 0}
+
+
+# -- reshard unit (the full-trainer N->M legs live in
+#    tests/test_sharded_checkpoint.py) ------------------------------------
+
+def test_reshard_rejects_incompatible_shapes(tmp_path, seed):
+    """A genuinely different model must raise naming the leaf, not
+    silently restore the saved shape (orbax would)."""
+    t1 = Trainer(max_epochs=10, max_steps=1, enable_checkpointing=False,
+                 num_sanity_val_steps=0, limit_val_batches=0, seed=0,
+                 log_every_n_steps=1, default_root_dir=str(tmp_path))
+    t1.fit(BoringModel())
+    ck = str(tmp_path / "ck")
+    t1.save_sharded_checkpoint(ck)
+    t1.wait_for_checkpoints()
+
+    class WiderBoring(BoringModel):
+        def configure_model(self):
+            from ray_lightning_tpu.models.boring import _Linear
+            return _Linear(5)    # 2 -> 5 output features
+
+    t2 = Trainer(max_epochs=10, max_steps=1, enable_checkpointing=False,
+                 num_sanity_val_steps=0, limit_val_batches=0, seed=0,
+                 log_every_n_steps=1, default_root_dir=str(tmp_path / "b"),
+                 resume_from_checkpoint=ck)
+    with pytest.raises(Exception, match="kernel"):
+        t2.fit(WiderBoring())
+
+
+def test_rebucket_preserves_injected_error_sum():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ray_lightning_tpu.elastic.reshard import _rebucket
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("data",))
+    rep = {"w": NamedSharding(mesh, P())}
+    old = {"w": np.arange(24, dtype=np.float32).reshape(4, 6)}
+    for m in (1, 2, 8):
+        new = np.asarray(_rebucket(old, m, rep)["w"])
+        assert new.shape == (m, 6)
+        np.testing.assert_allclose(new.sum(0) / m, old["w"].sum(0) / 4,
+                                   rtol=1e-6)

@@ -129,6 +129,65 @@ def test_heartbeat_watchdog_names_wedged_rank(caplog):
         assert box.get("outcome") == "raised"
 
 
+def test_elastic_shrink_to_continue_matches_clean_resume(tmp_path):
+    """THE elastic chaos proof (ISSUE 7 acceptance): a 2-worker run
+    with RLT_ELASTIC snapshotting on loses rank 1 to an injected kill
+    mid-run, shrinks to 1 worker WITHOUT a driver raise, and completes
+    to max_steps — and its final parameters equal a clean 1-worker
+    resume from the same snapshot (with the survivor's batch rescaled
+    so the global batch is preserved, the clean run uses the doubled
+    batch directly).  Tolerance: the 2-shard and 1-shard programs
+    reduce the same global batch in different summation orders, so
+    equality is allclose, not bitwise."""
+    import jax
+    import numpy as np
+    from tests.conftest import assert_tree_allclose
+
+    snap = str(tmp_path / "elastic")
+    trainer = Trainer(
+        max_epochs=20, max_steps=8, limit_val_batches=0,
+        num_sanity_val_steps=0, enable_checkpointing=False, seed=0,
+        log_every_n_steps=1, default_root_dir=str(tmp_path),
+        plugins=[cpu_plugin(
+            2, worker_env={"RLT_FAULT": "kill:rank=1,step=5"})],
+        elastic={"snapshot_every_n_steps": 2, "snapshot_dir": snap,
+                 "max_restarts": 2})
+    module = BoringModel(dataset_length=64, batch_size=2)
+    trainer.fit(module)             # the kill must NOT raise here
+
+    assert trainer.global_step == 8
+    rep = trainer._elastic_report
+    assert rep["restarts"] == 1
+    assert rep["workers"] == 1 and rep["initial_workers"] == 2
+    step = rep["resumed_step"]
+    assert step is not None, "no durable snapshot to resume from"
+    assert step < 8 and step % 2 == 0
+    # the resumed segment kept snapshotting (bounded backpressure:
+    # every cadence hit either saved or was counted as skipped)
+    assert rep["snapshots"] + rep["skipped"] >= 1
+    params_elastic = module._trained_variables["params"]
+
+    # clean comparison: 1 worker resuming the SAME snapshot with the
+    # doubled per-worker batch (2 workers x 2 == 1 worker x 4 — the
+    # same global batches, so the trajectories must agree)
+    module2 = BoringModel(dataset_length=64, batch_size=4)
+    clean = Trainer(
+        max_epochs=20, max_steps=8, limit_val_batches=0,
+        num_sanity_val_steps=0, enable_checkpointing=False, seed=0,
+        log_every_n_steps=1, default_root_dir=str(tmp_path / "clean"),
+        plugins=[cpu_plugin(1)],
+        resume_from_checkpoint=os.path.join(snap, str(step)))
+    clean.fit(module2)
+    assert clean.global_step == 8
+    params_clean = module2._trained_variables["params"]
+    assert_tree_allclose(params_elastic, params_clean)
+    # and the run actually trained past the snapshot
+    delta = sum(
+        float(np.abs(np.asarray(a)).sum())
+        for a in jax.tree_util.tree_leaves(params_elastic))
+    assert delta > 0
+
+
 def test_driver_usable_after_worker_failure():
     """After a failed distributed fit, the driver process can run a fresh
     (local) fit — no leaked global state."""

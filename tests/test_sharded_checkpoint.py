@@ -204,6 +204,117 @@ def test_inflight_save_durable_when_fit_raises(tmp_path, seed):
     ck.close()
 
 
+def _comm_fit(tmp, batch_size, policy="comm", resume=None, max_steps=3):
+    """Single-process comm-plane fit whose mesh data size (== the
+    CommState residual world) is set by the batch size (the DDP mesh
+    clamps its data axis to the global batch)."""
+    trainer = Trainer(
+        max_epochs=10, max_steps=max_steps, enable_checkpointing=False,
+        num_sanity_val_steps=0, limit_val_batches=0, seed=0,
+        log_every_n_steps=1, default_root_dir=tmp,
+        comm_policy={"compress": "int8", "axes": ("data",)}
+        if policy == "comm" else None,
+        resume_from_checkpoint=resume)
+    trainer.fit(BoringModel(batch_size=batch_size))
+    return trainer
+
+
+def _np_tree(tree):
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+def test_commstate_reshard_2_to_4_rebuckets_residual(tmp_path, seed):
+    """N→M restore with both sides carrying the PR 5 error-feedback
+    residual: params + inner optimizer state restore exactly; the
+    [N, ...] residual re-buckets to [M, ...] by mean-broadcast — exact
+    in the injected-correction sum (1/world)·Σᵢ rᵢ, the documented
+    tolerance being only the per-rank attribution of the error."""
+    from ray_lightning_tpu.comm.collectives import CommState
+
+    t1 = _comm_fit(str(tmp_path / "a"), batch_size=2)   # world 2
+    assert isinstance(t1.state.opt_state, CommState)
+    res1 = _np_tree(t1.state.opt_state.residual)
+    assert jax.tree_util.tree_leaves(res1)[0].shape[0] == 2
+    assert any(np.abs(leaf).sum() > 0
+               for leaf in jax.tree_util.tree_leaves(res1))
+    ckdir = str(tmp_path / "ck")
+    t1.save_sharded_checkpoint(ckdir)
+    t1.wait_for_checkpoints()
+
+    # max_steps == saved step: restore only, zero new steps
+    t2 = _comm_fit(str(tmp_path / "b"), batch_size=4, resume=ckdir)
+    res2 = _np_tree(t2.state.opt_state.residual)
+    assert jax.tree_util.tree_leaves(res2)[0].shape[0] == 4
+    assert_tree_allclose(_np_tree(t1.state.params),
+                         _np_tree(t2.state.params), rtol=0, atol=0)
+    assert_tree_allclose(_np_tree(t1.state.opt_state.inner),
+                         _np_tree(t2.state.opt_state.inner),
+                         rtol=0, atol=0)
+    for a, b in zip(jax.tree_util.tree_leaves(res1),
+                    jax.tree_util.tree_leaves(res2)):
+        expect = np.broadcast_to(a.mean(0, keepdims=True), b.shape)
+        np.testing.assert_allclose(b, expect, rtol=1e-6)
+        # the invariant the re-bucket preserves exactly
+        np.testing.assert_allclose(b.sum(0) / b.shape[0],
+                                   a.sum(0) / a.shape[0], rtol=1e-6)
+
+
+def test_commstate_reshard_2_to_1_drops_residual(tmp_path, seed):
+    """Shrinking to world 1 leaves no compressed axis (the comm plane
+    resolves inert), so the saved residual is dropped — params and
+    inner optimizer state still restore exactly."""
+    from ray_lightning_tpu.comm.collectives import CommState
+
+    t1 = _comm_fit(str(tmp_path / "a"), batch_size=2)
+    ckdir = str(tmp_path / "ck")
+    t1.save_sharded_checkpoint(ckdir)
+    t1.wait_for_checkpoints()
+
+    t2 = _comm_fit(str(tmp_path / "b"), batch_size=1, resume=ckdir)
+    assert not isinstance(t2.state.opt_state, CommState)
+    assert_tree_allclose(_np_tree(t1.state.params),
+                         _np_tree(t2.state.params), rtol=0, atol=0)
+    assert_tree_allclose(_np_tree(t1.state.opt_state.inner),
+                         _np_tree(t2.state.opt_state), rtol=0, atol=0)
+
+
+def test_commstate_reshard_1_to_2_keeps_zero_residual(tmp_path, seed):
+    """Growing from a comm-less save into a comm-on topology: inner
+    state restores exactly and error feedback restarts from the zero
+    residual (nothing saved to re-bucket)."""
+    from ray_lightning_tpu.comm.collectives import CommState
+
+    t1 = _comm_fit(str(tmp_path / "a"), batch_size=1)   # world 1: inert
+    assert not isinstance(t1.state.opt_state, CommState)
+    ckdir = str(tmp_path / "ck")
+    t1.save_sharded_checkpoint(ckdir)
+    t1.wait_for_checkpoints()
+
+    t2 = _comm_fit(str(tmp_path / "b"), batch_size=2, resume=ckdir)
+    assert isinstance(t2.state.opt_state, CommState)
+    res2 = _np_tree(t2.state.opt_state.residual)
+    assert jax.tree_util.tree_leaves(res2)[0].shape[0] == 2
+    assert all((leaf == 0).all()
+               for leaf in jax.tree_util.tree_leaves(res2))
+    assert_tree_allclose(_np_tree(t1.state.params),
+                         _np_tree(t2.state.params), rtol=0, atol=0)
+    assert_tree_allclose(_np_tree(t1.state.opt_state),
+                         _np_tree(t2.state.opt_state.inner),
+                         rtol=0, atol=0)
+
+
+def test_sharded_meta_records_comm_world(tmp_path, seed):
+    t1 = _comm_fit(str(tmp_path / "a"), batch_size=2)
+    ckdir = str(tmp_path / "ck")
+    t1.save_sharded_checkpoint(ckdir)
+    t1.wait_for_checkpoints()
+    ck = ShardedCheckpointer(ckdir)
+    _, meta = ck.restore(
+        abstract_like(t1.state, t1._state_shardings))
+    ck.close()
+    assert meta["comm_world"] == 2
+
+
 def test_restore_missing_dir_raises(tmp_path):
     ck = ShardedCheckpointer(str(tmp_path / "empty"))
     with pytest.raises(FileNotFoundError):
