@@ -138,6 +138,11 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
     active = sync is not None or (
         pol is not None and pol.enabled and trainer.world_size > 1)
     result["comm"] = pol.compress if (active and pol is not None) else "fp32"
+    # planner plane: whether this run's parallelism was picked by the
+    # strategy="auto" cost model ("auto" — the PlanReport landed on
+    # trainer._plan_report) or hand-configured ("manual")
+    result["plan"] = ("auto" if getattr(trainer, "_plan_report", None)
+                      else "manual")
     paths = getattr(trainer, "_telemetry_paths", None)
     if paths:
         result["telemetry_jsonl"] = paths["jsonl"]

@@ -61,6 +61,12 @@ if [[ "$CHECK" == 1 ]]; then
     # (ray_lightning_tpu/elastic/selfcheck.py)
     python -c 'import sys; from ray_lightning_tpu.elastic.selfcheck \
         import _main; sys.exit(_main([]))'
+    # planner-plane selfcheck: PlanConfig validation + RLT_PLAN* env
+    # round-trip, enumeration coverage/pruning reasons, byte→seconds
+    # score monotonicity, PlanReport schema, plan metric names
+    # (ray_lightning_tpu/plan/selfcheck.py)
+    python -c 'import sys; from ray_lightning_tpu.plan.selfcheck \
+        import _main; sys.exit(_main([]))'
 fi
 
 if [[ "$ALL" == 1 ]]; then

@@ -51,6 +51,7 @@ from ray_lightning_tpu.plugins import (
 )
 from ray_lightning_tpu.comm import CommPolicy
 from ray_lightning_tpu.elastic import ElasticConfig
+from ray_lightning_tpu.plan import PlanConfig
 
 __version__ = "0.1.0"
 
@@ -83,6 +84,7 @@ __all__ = [
     "RayXlaSpmdPlugin",
     "CommPolicy",
     "ElasticConfig",
+    "PlanConfig",
     "Server",
     "__version__",
 ]

@@ -108,3 +108,31 @@ def total_wire_bytes(hlo_text: str, axis_size: int = 1, *,
             continue
         total += b
     return total
+
+
+# -- byte → seconds (planner cost model) -----------------------------------
+
+#: modeled payload bandwidths, in GB/s (1e9 bytes/s), of the two link
+#: classes a collective can ride.  ICI: the intra-slice interconnect —
+#: v4/v5e per-chip ~100 GB/s order of magnitude.  DCN: the cross-host
+#: datacenter network — ~100 Gbit/s per host ≈ 12.5 GB/s, the slow link
+#: the comm plane compresses across.  These are deliberately coarse
+#: constants for RANKING candidate plans (the plan/ planner), not for
+#: predicting absolute step time; override per fabric generation via
+#: PlanConfig / RLT_PLAN_{ICI,DCN}_GBPS.
+ICI_GBPS = 100.0
+DCN_GBPS = 12.5
+
+
+def bytes_to_seconds(nbytes, gbps: float) -> float:
+    """Seconds the given wire payload occupies a ``gbps``-GB/s link —
+    the planner's byte→seconds conversion.  ``nbytes`` may be an int or
+    an op→bytes mapping (``step_collective_bytes`` /
+    :func:`collective_wire_bytes` output); mappings sum their values.
+    Strictly monotone in bytes (plan/selfcheck.py pins this — the
+    ranking invariant the whole cost model rests on)."""
+    if isinstance(nbytes, dict):
+        nbytes = sum(nbytes.values())
+    if gbps <= 0:
+        raise ValueError(f"bandwidth must be positive, got {gbps}")
+    return float(nbytes) / (gbps * 1e9)

@@ -44,6 +44,10 @@ def _main(argv) -> int:   # noqa: ARG001 - argv kept for parity
     expect_sync = {"ddp": True, "dp": True, "zero1": True, "sharded": True,
                    "fsdp": False, "zero3": False, "spmd": False}
     for name in sorted(_STRATEGIES):
+        if name == "auto":
+            # planner sentinel (plan/): resolved into one of the
+            # concrete strategies below before any mesh/grad_sync exists
+            continue
         strat = resolve_strategy(name)
         mesh = strat.build_mesh()
         got = build_grad_sync(strat, mesh, policy) is not None

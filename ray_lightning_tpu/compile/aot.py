@@ -33,6 +33,7 @@ import logging
 import os
 import threading
 import time
+from dataclasses import dataclass
 from typing import Any, Optional
 
 from ray_lightning_tpu.telemetry import counter as _tcounter
@@ -124,6 +125,106 @@ class AotPrecompiler:
         return isinstance(self.results.get(name), float)
 
 
+# -- batched AOT scoring (planner verify stage) ----------------------------
+
+@dataclass
+class ScoredCompile:
+    """What one AOT candidate compile yields for plan ranking: measured
+    compile seconds, the backend's real per-device memory analysis, and
+    the audited HLO collective wire bytes (comm/audit.py model)."""
+
+    name: str
+    seconds: float = 0.0
+    argument_bytes: int = 0
+    output_bytes: int = 0
+    temp_bytes: int = 0
+    alias_bytes: int = 0
+    wire_bytes: int = 0
+    error: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+    @property
+    def peak_bytes(self) -> int:
+        """Per-device residency of one step dispatch: live arguments +
+        outputs + XLA temp workspace, minus the aliased (donated)
+        buffers counted on both sides."""
+        return max(0, self.argument_bytes + self.output_bytes
+                   + self.temp_bytes - self.alias_bytes)
+
+    def to_dict(self) -> dict:
+        return {
+            "compile_seconds": round(self.seconds, 6),
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "alias_bytes": self.alias_bytes,
+            "peak_bytes": self.peak_bytes,
+            "wire_bytes": self.wire_bytes,
+            "error": self.error,
+        }
+
+
+def compile_scored(programs: "list[tuple[str, Any, tuple, int]]",
+                   max_workers: int = 4) -> "dict[str, ScoredCompile]":
+    """AOT-compile candidate programs concurrently and score each.
+
+    ``programs`` entries are ``(name, jitted, abstract_args,
+    axis_size)`` — ``axis_size`` scales reduce-scatter results back to
+    input bytes in the wire audit.  Unlike :class:`AotPrecompiler`
+    (one thread — its compiles overlap the main thread's init compile),
+    these run BEFORE any other compilation exists, so a small pool is
+    pure win; with the persistent cache active every artifact lands on
+    disk and the winner's first real dispatch collapses to a cache
+    retrieval.  Failure is per-program soft: a candidate whose compile
+    raises scores as an error entry instead of sinking the whole plan.
+    """
+    import concurrent.futures
+
+    from ray_lightning_tpu.comm.audit import total_wire_bytes
+
+    def one(entry) -> ScoredCompile:
+        name, jitted, args, axis_size = entry
+        t0 = time.monotonic()
+        try:
+            compiled = jitted.lower(*args).compile()
+        except Exception as e:   # noqa: BLE001 - per-candidate soft fail
+            return ScoredCompile(name=name,
+                                 seconds=time.monotonic() - t0,
+                                 error=f"{type(e).__name__}: {e}")
+        out = ScoredCompile(name=name, seconds=time.monotonic() - t0)
+        try:
+            mem = compiled.memory_analysis()
+            out.argument_bytes = int(
+                getattr(mem, "argument_size_in_bytes", 0) or 0)
+            out.output_bytes = int(
+                getattr(mem, "output_size_in_bytes", 0) or 0)
+            out.temp_bytes = int(
+                getattr(mem, "temp_size_in_bytes", 0) or 0)
+            out.alias_bytes = int(
+                getattr(mem, "alias_size_in_bytes", 0) or 0)
+        except Exception:   # noqa: BLE001 - backend without the API
+            _log.debug("memory_analysis unavailable for %s", name,
+                       exc_info=True)
+        try:
+            out.wire_bytes = total_wire_bytes(compiled.as_text(),
+                                              axis_size=axis_size)
+        except Exception:   # noqa: BLE001 - text dump unavailable
+            _log.debug("HLO wire audit unavailable for %s", name,
+                       exc_info=True)
+        return out
+
+    if not programs:
+        return {}
+    workers = max(1, min(max_workers, len(programs)))
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=workers,
+            thread_name_prefix="rlt-plan-aot") as pool:
+        return {s.name: s for s in pool.map(one, programs)}
+
+
 # -- abstract-aval helpers -------------------------------------------------
 
 def global_batch_abstract(host_batch, process_count: int):
@@ -163,6 +264,8 @@ def stack_abstract(abstract_batch, k: int):
 __all__ = [
     "AotPrecompiler",
     "ENV_AOT",
+    "ScoredCompile",
+    "compile_scored",
     "global_batch_abstract",
     "stack_abstract",
 ]

@@ -111,6 +111,7 @@ class Trainer:
         compile_cache: Any = None,
         comm_policy: Any = None,
         elastic: Any = None,
+        plan: Any = None,
     ):
         if max_epochs is None and (max_steps is None or max_steps < 0):
             max_epochs = 1000
@@ -182,6 +183,12 @@ class Trainer:
         # config pickles driver→worker with the trainer.
         from ray_lightning_tpu.elastic import ElasticConfig
         self.elastic = ElasticConfig.resolve(elastic)
+        # planner plane (plan/): cost-model-driven auto-parallelism
+        # behind Trainer(strategy="auto").  None defers to the RLT_PLAN*
+        # env knobs; the frozen config pickles driver→worker with the
+        # trainer so every rank plans from identical inputs.
+        from ray_lightning_tpu.plan import PlanConfig
+        self.plan = PlanConfig.resolve(plan)
         from ray_lightning_tpu.utils.logger import resolve_logger
         self.logger = resolve_logger(logger, self.default_root_dir)
 
@@ -231,6 +238,13 @@ class Trainer:
         self._elastic_report: Optional[dict] = None
         self._elastic_worker_stats: Optional[dict] = None
         self._warned_rescale = False
+        #: the planner's machine-readable verdict (PlanReport dict) when
+        #: strategy="auto" ran; rank-0's copy rides the worker result
+        #: package back to the driver (plugins/xla.py)
+        self._plan_report: Optional[dict] = None
+        #: the winning plan's donation decision, consulted by
+        #: _should_donate between the RLT_DONATE force and the heuristic
+        self._plan_donate: Optional[bool] = None
 
     # ------------------------------------------------------------------
     # pickling across the driver→worker boundary (ray_ddp.py:164-172
@@ -385,6 +399,13 @@ class Trainer:
         batch_hint = (leaves[0].shape[0] * jax.process_count()
                       if leaves and getattr(leaves[0], "ndim", 0) > 0
                       else None)
+        if getattr(strategy, "name", "") == "auto":
+            # planner plane (plan/): everything the cost model needs —
+            # module, example batch, topology — is known exactly here,
+            # one line before the mesh would be built
+            strategy = self._resolve_auto_strategy(
+                module, example_batch, batch_hint, strategy, stage)
+            self.plugin.strategy = strategy
         self._mesh = strategy.build_mesh(self.plugin.local_devices(),
                                          batch_hint=batch_hint)
         set_current_mesh(self._mesh)  # for mesh-aware ops (ring attention)
@@ -499,6 +520,57 @@ class Trainer:
             return {"test": self._get_loader("test")}
         return {"predict": self._get_loader("predict")}
 
+    # -- auto-parallelism (plan/) ----------------------------------------
+
+    def _resolve_auto_strategy(self, module, example_batch, batch_hint,
+                               auto, stage: str):
+        """Run the planner and apply its winning plan: the concrete
+        strategy is returned; the comm policy, donation decision and
+        microbatch land on the trainer directly (they are trainer
+        concerns the strategy object cannot carry).  The full
+        :class:`PlanReport` dict lands on ``_plan_report`` and the
+        ``rlt_plan_*`` gauges.  Planning scores the TRAIN step, so
+        eval/predict-only stages fall back to DDP with a log line
+        instead of paying candidate compiles they would never use."""
+        from ray_lightning_tpu.comm import CommPolicy
+        if stage != "fit":
+            _log.info("strategy='auto' plans the train step; %s stage "
+                      "falls back to ddp", stage)
+            return resolve_strategy("ddp")
+        from ray_lightning_tpu.plan import Planner
+        cfg = auto.plan if getattr(auto, "plan", None) is not None \
+            else self.plan
+        planner = Planner(cfg)
+        # a user-set accumulate_grad_batches pins the microbatch
+        # dimension; the default (1) lets the config's options explore
+        mb = (self.accumulate_grad_batches,) \
+            if self.accumulate_grad_batches > 1 else None
+        with span("plan"):
+            report = planner.plan(
+                module, self._host_cast(example_batch),
+                devices=self.plugin.local_devices(),
+                batch_hint=batch_hint,
+                base_comm_policy=self.comm_policy,
+                microbatch_options=mb,
+                tx_factory=lambda gs: self._configure_tx(module, gs))
+        self._plan_report = d = report.to_dict()
+        winner = report.winner_candidate
+        if winner.comm:
+            self.comm_policy = report.winner_policy
+        else:
+            self.comm_policy = CommPolicy()
+        self._plan_donate = bool(winner.donate)
+        self.accumulate_grad_batches = int(winner.microbatch)
+        _log.info("plan: %s", report.summary())
+        reg = _metrics.get_registry()
+        if reg is not None:
+            reg.gauge("rlt_plan_candidates_total").set(d["enumerated"])
+            reg.gauge("rlt_plan_pruned_total").set(d["pruned"])
+            reg.gauge("rlt_plan_rejected_total").set(d["rejected"])
+            reg.gauge("rlt_plan_compiled_total").set(d["compiled"])
+            reg.gauge("rlt_plan_seconds").set(round(d["plan_seconds"], 6))
+        return winner.build_strategy()
+
     # -- compilation -----------------------------------------------------
 
     def _configure_tx(self, module, grad_sync=None):
@@ -577,6 +649,12 @@ class Trainer:
             warnings.warn(
                 f"RLT_DONATE={env!r} is neither '0' nor '1'; using the "
                 "auto heuristic")
+        if self._plan_donate is not None:
+            # strategy="auto": the planner already decided donation per
+            # candidate (same cutoff logic, budget-checked and — for the
+            # top-k — verified against the compiled memory_analysis);
+            # RLT_DONATE above still force-overrides either way
+            return self._plan_donate
         limit = self._device_memory_budget()
         if limit is None:
             return True

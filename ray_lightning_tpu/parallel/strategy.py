@@ -205,6 +205,26 @@ class ShardingStrategy:
         return sum(int(np.prod(getattr(leaf, "shape", ()), dtype=np.int64))
                    for leaf in jax.tree_util.tree_leaves(tree))
 
+    # -- planner introspection hooks (plan/candidates.py) ------------------
+
+    @classmethod
+    def plan_mesh_options(cls, n_devices: int) -> tuple:
+        """Feasible mesh factorizations of ``n_devices`` this strategy
+        can plan over, as axis_sizes dicts — the planner enumerates one
+        candidate per entry.  Single-axis strategies have exactly one
+        layout; multi-axis strategies (SpmdStrategy) override with
+        their divisor factorizations.  New strategies self-describe by
+        overriding this pair of hooks rather than teaching the planner
+        about themselves."""
+        return ({"data": n_devices},)
+
+    @classmethod
+    def from_plan(cls, axis_sizes: dict) -> "ShardingStrategy":
+        """Construct the strategy instance for one
+        :meth:`plan_mesh_options` entry."""
+        del axis_sizes   # single-axis strategies: nothing to configure
+        return cls()
+
     def grad_transform(self, mesh: Mesh, policy):
         """Resolve a comm policy against this strategy on this mesh: a
         ``comm.GradSync`` the step builder routes the gradient reduction
@@ -327,6 +347,23 @@ class FullyShardedStrategy(Zero1Strategy):
             return P()
         return _axis_spec(aval.shape, "data", mesh.shape["data"])
 
+    def step_collective_bytes(self, mesh: Mesh, abstract_state,
+                              comm=None) -> dict:
+        """FSDP step traffic: params all-gathered at their use sites in
+        BOTH forward and backward (2× params' worth) plus the gradient
+        reduce-scatter (one params' worth) — strictly more than
+        ZeRO-1's 2× total, which the inherited declaration used to
+        claim.  Declared separately so the planner's cost model ranks
+        FSDP below ZeRO-1 on comm whenever both fit (the memory story
+        is what FSDP buys).  The comm plane declines param-sharded
+        strategies, so ``comm`` never compresses these bytes."""
+        del comm
+        if self.data_parallel_size(mesh) <= 1:
+            return {}
+        params = self._tree_bytes(abstract_state.params)
+        return {"param_all_gather": 2 * params,
+                "grad_reduce_scatter": params}
+
 
 class SpmdStrategy(ShardingStrategy):
     """General SPMD over a multi-axis mesh with regex partition rules.
@@ -433,6 +470,65 @@ class SpmdStrategy(ShardingStrategy):
             spec[3] = "tensor"
         return P(*spec)
 
+    def step_collective_bytes(self, mesh: Mesh, abstract_state,
+                              comm=None) -> dict:
+        """Approximate SPMD step traffic for the planner/metrics byte
+        model: an active ``fsdp`` axis gathers params at use in forward
+        and backward and reduce-scatters grads (the FSDP story); an
+        active ``data`` axis additionally all-reduces grads across
+        replicas.  Tensor/sequence-rule traffic (activation
+        collectives) is NOT modeled — rule-driven layouts are
+        hand-written configurations the planner does not enumerate.
+        The comm plane declines SPMD, so ``comm`` never applies."""
+        del comm
+        out: dict = {}
+        params = self._tree_bytes(abstract_state.params)
+        if mesh_axis_size(mesh, "fsdp") > 1:
+            out["param_all_gather"] = 2 * params
+            out["grad_reduce_scatter"] = params
+        if mesh_axis_size(mesh, "data") > 1:
+            out["grad_all_reduce"] = params
+        return out
+
+    @classmethod
+    def plan_mesh_options(cls, n_devices: int) -> tuple:
+        """Every ``data × fsdp`` factorization with a non-trivial fsdp
+        axis (fsdp=1 would duplicate the plain DDP candidate).  The
+        planner's generic SPMD candidate is rule-less — params fall to
+        the fsdp-shard fallback — so the fsdp axis is the dimension
+        that matters; rule-driven tensor/sequence layouts stay a
+        hand-written ``SpmdStrategy`` concern."""
+        return tuple({"data": n_devices // f, "fsdp": f}
+                     for f in range(2, n_devices + 1)
+                     if n_devices % f == 0)
+
+    @classmethod
+    def from_plan(cls, axis_sizes: dict) -> "SpmdStrategy":
+        return cls(axis_names=("data", "fsdp"),
+                   axis_sizes={"fsdp": int(axis_sizes.get("fsdp", 1))})
+
+
+class AutoStrategy(ShardingStrategy):
+    """Sentinel for ``Trainer(strategy="auto")``: the planner plane
+    (ray_lightning_tpu/plan/) resolves it into a concrete strategy —
+    plus a comm policy, donation and microbatch decision — once the
+    module, example batch and device topology are known inside
+    ``_run_stage``.  Carries an optional :class:`plan.PlanConfig`
+    override; holds no other state, so it pickles driver→worker like
+    any strategy.  Using it unresolved is a wiring bug and fails
+    loudly."""
+
+    name = "auto"
+
+    def __init__(self, plan=None):
+        self.plan = plan
+
+    def build_mesh(self, devices=None, batch_hint=None) -> Mesh:
+        raise RuntimeError(
+            "strategy='auto' must be resolved by the planner before a "
+            "mesh can be built (Trainer._resolve_auto_strategy); "
+            "constructing AutoStrategy outside a Trainer is unsupported")
+
 
 _STRATEGIES = {
     "ddp": DataParallelStrategy,
@@ -442,10 +538,44 @@ _STRATEGIES = {
     "fsdp": FullyShardedStrategy,
     "zero3": FullyShardedStrategy,
     "spmd": SpmdStrategy,
+    "auto": AutoStrategy,
 }
 
 
+def strategy_names() -> list:
+    """Every accepted ``Trainer(strategy=...)`` string, sorted (single
+    source of truth for error messages, the planner inventory and the
+    README table)."""
+    return sorted(_STRATEGIES)
+
+
 def resolve_strategy(strategy: "str | ShardingStrategy | None") -> ShardingStrategy:
+    """Resolve ``Trainer(strategy=...)`` into a :class:`ShardingStrategy`.
+
+    Accepted values — an instance passes through; ``None`` defaults to
+    DDP; a string selects by name (THE canonical list; the README
+    "Parallelism" table mirrors it):
+
+    =====================  ===============================================
+    name                   strategy
+    =====================  ===============================================
+    ``"ddp"`` / ``"dp"``   :class:`DataParallelStrategy` — state
+                           replicated, batch sharded, XLA psums grads
+    ``"zero1"`` /          :class:`Zero1Strategy` — optimizer state
+    ``"sharded"``          sharded across data ranks (FairScale-OSS
+                           parity; "sharded" is the reference's name)
+    ``"fsdp"`` /           :class:`FullyShardedStrategy` — params AND
+    ``"zero3"``            optimizer state sharded, gathered at use
+    ``"spmd"``             :class:`SpmdStrategy` — general multi-axis
+                           mesh with regex partition rules
+    ``"auto"``             :class:`AutoStrategy` — the planner plane
+                           (ray_lightning_tpu/plan/) picks strategy,
+                           mesh, comm policy, donation and microbatch
+                           from a cost model over the candidates above
+    =====================  ===============================================
+
+    Unknown names raise a ``ValueError`` listing the valid set.
+    """
     if strategy is None:
         return DataParallelStrategy()
     if isinstance(strategy, ShardingStrategy):
@@ -454,6 +584,9 @@ def resolve_strategy(strategy: "str | ShardingStrategy | None") -> ShardingStrat
         key = strategy.lower()
         if key not in _STRATEGIES:
             raise ValueError(
-                f"Unknown strategy {strategy!r}; options: {sorted(_STRATEGIES)}")
+                f"Unknown strategy {strategy!r}; valid strategy names: "
+                f"{strategy_names()} (see resolve_strategy's docstring "
+                f"or the README 'Parallelism' table for what each "
+                f"selects)")
         return _STRATEGIES[key]()
     raise TypeError(f"Bad strategy: {strategy!r}")

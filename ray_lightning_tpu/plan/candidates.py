@@ -1,0 +1,155 @@
+"""Candidate-plan enumeration.
+
+A *candidate* is one complete parallelism configuration the trainer
+could run: strategy × mesh factorization × comm policy on/off ×
+donation on/off × grad-accumulation microbatch.  Enumeration here is
+purely combinatorial — strategies self-describe their feasible meshes
+via the ``plan_mesh_options`` / ``from_plan`` hooks
+(parallel/strategy.py) — and prunes statically-infeasible combinations
+up front with a NAMED reason (batch indivisible across the data shards,
+comm on a param-sharded strategy, no DCN hop to compress, microbatch
+not dividing the per-shard batch).  Budget-dependent rejection needs
+avals and happens later, in plan/cost.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ray_lightning_tpu.plan.config import PlanConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One enumerated plan (hashable; the planner memo keys on it)."""
+
+    strategy: str                 # canonical name ("ddp"/"zero1"/...)
+    axis_sizes: tuple             # sorted ((axis, size), ...) pairs
+    comm: bool = False            # compressed gradient collectives on?
+    donate: bool = True           # donate the TrainState into the step?
+    microbatch: int = 1           # accumulate_grad_batches
+
+    @property
+    def label(self) -> str:
+        mesh = "x".join(f"{a}{s}" for a, s in self.axis_sizes)
+        parts = [f"{self.strategy}[{mesh}]"]
+        if self.comm:
+            parts.append("comm")
+        if not self.donate:
+            parts.append("nodonate")
+        if self.microbatch > 1:
+            parts.append(f"mb{self.microbatch}")
+        return ":".join(parts)
+
+    @property
+    def mesh_sizes(self) -> dict:
+        return dict(self.axis_sizes)
+
+    def data_parallel_size(self) -> int:
+        """Product of the batch-sharding axes (data + fsdp — the axes
+        every built-in strategy declares as ``data_axis_names``)."""
+        sizes = self.mesh_sizes
+        return sizes.get("data", 1) * sizes.get("fsdp", 1)
+
+    def build_strategy(self):
+        from ray_lightning_tpu.parallel.strategy import _STRATEGIES
+        return _STRATEGIES[self.strategy].from_plan(self.mesh_sizes)
+
+    def to_dict(self) -> dict:
+        return {
+            "label": self.label,
+            "strategy": self.strategy,
+            "mesh": self.mesh_sizes,
+            "comm": self.comm,
+            "donate": self.donate,
+            "microbatch": self.microbatch,
+        }
+
+
+def policy_for_candidate(candidate: Candidate, base_policy=None):
+    """The :class:`CommPolicy` a comm-on candidate runs under: the
+    user's own policy when one is active (the planner then decides
+    WHETHER to apply it, not how), else the default aggressive setting
+    — int8 on the data axis, the EQuARX-style DCN compression the comm
+    plane was built for.  ``None`` for comm-off candidates."""
+    if not candidate.comm:
+        return None
+    from ray_lightning_tpu.comm import CommPolicy
+    if base_policy is not None and base_policy.enabled:
+        return base_policy
+    return CommPolicy(compress="int8", axes=("data",))
+
+
+def enumerate_candidates(
+    n_devices: int,
+    global_batch: Optional[int],
+    config: PlanConfig,
+    process_count: int = 1,
+    microbatch_options: Optional[tuple] = None,
+    comm_enabled_hint: bool = False,
+) -> "tuple[list[Candidate], list[tuple[str, str]]]":
+    """All statically-feasible candidates plus the pruned combinations.
+
+    Returns ``(candidates, pruned)`` where ``pruned`` is a list of
+    ``(label, reason)`` — every reason names the violated constraint so
+    the PlanReport can answer "why was X not considered".  Pruning
+    happens at the outermost level where the constraint binds (one
+    entry per pruned subtree, not one per leaf combination).
+
+    ``comm_enabled_hint`` marks a user-supplied active comm policy:
+    comm-on candidates are then enumerated even on a single process
+    (the explicit-axes opt-in the CPU tests use); without it, a
+    single-process run has no DCN hop worth compressing and comm-on is
+    pruned.
+    """
+    from ray_lightning_tpu.parallel.strategy import _STRATEGIES
+
+    microbatch = tuple(microbatch_options or config.microbatch)
+    candidates: list[Candidate] = []
+    pruned: list[tuple[str, str]] = []
+
+    for name in config.strategies:
+        cls = _STRATEGIES[name]
+        for sizes in cls.plan_mesh_options(n_devices):
+            axis_sizes = tuple(sorted(sizes.items()))
+            base = Candidate(strategy=name, axis_sizes=axis_sizes)
+            dp = base.data_parallel_size()
+            if global_batch is not None and global_batch % dp:
+                pruned.append((base.label,
+                               f"batch_indivisible: global batch "
+                               f"{global_batch} does not divide across "
+                               f"{dp} data shards"))
+                continue
+            comm_options = [False]
+            if cls.comm_compressible:
+                if process_count > 1 or comm_enabled_hint:
+                    comm_options.append(True)
+                else:
+                    pruned.append((
+                        f"{base.label}:comm",
+                        "comm_no_dcn: single-process mesh is all-ICI; "
+                        "nothing to compress (pass an explicit "
+                        "CommPolicy(axes=...) to opt in)"))
+            elif process_count > 1 or comm_enabled_hint:
+                pruned.append((
+                    f"{base.label}:comm",
+                    f"comm_unsupported: strategy {name!r} keeps params "
+                    f"sharded across the reduction axes (comm plane "
+                    f"declines, parallel/strategy.py comm_compressible)"))
+            for comm in comm_options:
+                for mb in microbatch:
+                    if mb > 1 and global_batch is not None \
+                            and global_batch % (dp * mb):
+                        pruned.append((
+                            dataclasses.replace(
+                                base, comm=comm, microbatch=mb).label,
+                            f"microbatch_indivisible: global batch "
+                            f"{global_batch} does not split into "
+                            f"{mb} microbatches over {dp} data shards"))
+                        continue
+                    for donate in (True, False):
+                        candidates.append(dataclasses.replace(
+                            base, comm=comm, donate=donate,
+                            microbatch=mb))
+    return candidates, pruned

@@ -1,0 +1,172 @@
+"""Compile-free candidate scoring: bytes → seconds, avals → HBM fit.
+
+Two estimates per candidate, both computed WITHOUT compiling anything:
+
+- **communication seconds**: the strategy's own per-step traffic
+  declaration (``step_collective_bytes`` — the same numbers the metrics
+  plane charges, pinned against audited HLO wire bytes by
+  tests/test_plan.py's drift guard) converted through the per-link
+  bandwidth model (comm/audit.py ``bytes_to_seconds``; DCN when the run
+  spans processes — the mesh construction puts the data axis across
+  hosts — ICI otherwise).
+- **HBM peak**: the sharded TrainState residency from ``eval_shape``
+  avals + the strategy's shardings (exact per-leaf shard bytes, the
+  tests/test_memory_fit.py account), plus the big transients (grads at
+  param dtype and fp32 update deltas, mirroring the PARAM sharding —
+  replicated-param strategies materialize them full-size, param-sharded
+  ones keep them shard-sized) and a crude batch-proportional activation
+  proxy that grad-accumulation divides.  Donation follows the measured
+  decision logic: an un-donated step carries a second state copy
+  (old + new — the ``Trainer._donation_cutoff`` story).
+
+Candidates whose modeled peak exceeds the headroom-scaled budget are
+rejected with a named reason; the AOT verify stage later replaces these
+estimates with the compiled program's real ``memory_analysis`` bytes
+and audited wire bytes for the top-k survivors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ray_lightning_tpu.comm.audit import bytes_to_seconds
+from ray_lightning_tpu.plan.candidates import Candidate
+from ray_lightning_tpu.plan.config import PlanConfig
+
+
+def sharded_bytes(abstract_tree, shardings_tree) -> int:
+    """Per-device bytes of ``abstract_tree`` under the given shardings
+    (exact: per-leaf ``shard_shape``)."""
+    leaves = jax.tree_util.tree_leaves(abstract_tree)
+    shs = jax.tree_util.tree_leaves(
+        shardings_tree, is_leaf=lambda x: hasattr(x, "spec"))
+    total = 0
+    for aval, sh in zip(leaves, shs):
+        shape = sh.shard_shape(aval.shape) \
+            if hasattr(sh, "shard_shape") else aval.shape
+        total += int(np.prod(shape, dtype=np.int64)) * aval.dtype.itemsize
+    return total
+
+
+def _sharded_elements(abstract_tree, shardings_tree) -> int:
+    leaves = jax.tree_util.tree_leaves(abstract_tree)
+    shs = jax.tree_util.tree_leaves(
+        shardings_tree, is_leaf=lambda x: hasattr(x, "spec"))
+    total = 0
+    for aval, sh in zip(leaves, shs):
+        shape = sh.shard_shape(aval.shape) \
+            if hasattr(sh, "shard_shape") else aval.shape
+        total += int(np.prod(shape, dtype=np.int64))
+    return total
+
+
+def device_memory_budget(device, config: PlanConfig) -> Optional[int]:
+    """Per-device HBM budget: the config override, the runtime's
+    reported limit, or the known-HBM-by-kind table the donation
+    heuristic uses (core/trainer.py) — ``None`` when nothing knows
+    (virtual CPU meshes), in which case memory never rejects."""
+    if config.hbm_budget_bytes is not None:
+        return int(config.hbm_budget_bytes)
+    try:
+        stats = device.memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    if getattr(device, "platform", None) == "tpu":
+        from ray_lightning_tpu.core.trainer import Trainer
+        return Trainer._HBM_BY_KIND.get(getattr(device, "device_kind", ""))
+    return None
+
+
+@dataclasses.dataclass
+class Estimate:
+    """One candidate's compile-free score."""
+
+    comm_bytes: int
+    comm_seconds: float
+    state_bytes: int           # sharded TrainState residency per device
+    peak_bytes: int            # state + transients (+ un-donated copy)
+    budget: Optional[int]
+    donate_preferred: bool     # what the measured donation heuristic
+    #                            would pick for this state/budget pair
+    reason: Optional[str] = None   # rejection reason (None = fits)
+
+    @property
+    def fits(self) -> bool:
+        return self.reason is None
+
+    def to_dict(self) -> dict:
+        return {
+            "comm_bytes": int(self.comm_bytes),
+            "comm_seconds": float(self.comm_seconds),
+            "state_bytes": int(self.state_bytes),
+            "peak_bytes": int(self.peak_bytes),
+            "budget_bytes": self.budget,
+            "donate_preferred": self.donate_preferred,
+        }
+
+
+def estimate_candidate(
+    candidate: Candidate,
+    strategy,
+    mesh,
+    abstract_state,
+    shardings,
+    batch_bytes_global: int,
+    config: PlanConfig,
+    process_count: int,
+    grad_sync=None,
+) -> Estimate:
+    """Score one candidate from avals alone (module docstring)."""
+    from ray_lightning_tpu.core.trainer import Trainer
+
+    op_bytes = strategy.step_collective_bytes(mesh, abstract_state,
+                                              comm=grad_sync)
+    comm_bytes = int(sum(op_bytes.values()))
+    gbps = config.dcn_gbps if process_count > 1 else config.ici_gbps
+    comm_seconds = bytes_to_seconds(comm_bytes, gbps)
+
+    state_bytes = sharded_bytes(abstract_state, shardings)
+    # grads mirror the param sharding at param dtype; fp32 update deltas
+    # likewise (replicated-param strategies materialize both full-size —
+    # the audited f32 all-gather of updates, tests/test_memory_fit.py)
+    grads_bytes = sharded_bytes(abstract_state.params, shardings.params)
+    updates_bytes = 4 * _sharded_elements(abstract_state.params,
+                                          shardings.params)
+    dp = max(1, strategy.data_parallel_size(mesh))
+    act_bytes = int(batch_bytes_global / dp * config.activation_factor
+                    / max(1, candidate.microbatch))
+    peak = (state_bytes * (1 if candidate.donate else 2)
+            + grads_bytes + updates_bytes + act_bytes)
+
+    budget = device_memory_budget(mesh.devices.flat[0], config)
+    donate_preferred = True if budget is None \
+        else Trainer._donation_cutoff(state_bytes, budget)
+    reason = None
+    if budget is not None and peak > config.headroom * budget:
+        reason = (f"hbm_over_budget: modeled peak {peak >> 20} MiB "
+                  f"({'donated' if candidate.donate else 'un-donated'}) "
+                  f"> {int(config.headroom * budget) >> 20} MiB "
+                  f"({config.headroom:.0%} of {budget >> 20} MiB/device)")
+    return Estimate(comm_bytes=comm_bytes, comm_seconds=comm_seconds,
+                    state_bytes=state_bytes, peak_bytes=peak,
+                    budget=budget, donate_preferred=donate_preferred,
+                    reason=reason)
+
+
+def rank_key(candidate: Candidate, est: Estimate) -> tuple:
+    """Deterministic ranking key for modeled scores: fewest modeled
+    comm seconds first; between otherwise-equal candidates the donation
+    flag agreeing with the MEASURED donation heuristic wins (small
+    states run faster un-donated, large/unknown donate —
+    ``Trainer._donation_cutoff``); then lower peak, then label (total
+    order — every rank of an SPMD fleet computes the same key from the
+    same pickled config, which is what lets ``strategy="auto"`` agree
+    on one winner without a collective)."""
+    mismatch = 0 if candidate.donate == est.donate_preferred else 1
+    return (est.comm_seconds, mismatch, est.peak_bytes, candidate.label)

@@ -1,0 +1,365 @@
+"""The planner: enumerate → score → verify → pick.
+
+``Planner.plan`` turns (module, example batch, topology) into one
+winning :class:`Candidate` plus a full :class:`PlanReport`:
+
+1. **Enumerate** (plan/candidates.py): strategy × mesh factorization ×
+   comm × donation × microbatch, statically-infeasible combinations
+   pruned with named reasons.
+2. **Score without compiling** (plan/cost.py): per-step communication
+   seconds from each strategy's ``step_collective_bytes`` declaration
+   through the per-link bandwidth model, HBM peak from ``eval_shape``
+   avals + shardings + the measured donation decision logic;
+   over-budget candidates rejected with named reasons.
+3. **Verify cheaply** (compile/aot.py ``compile_scored``): AOT-compile
+   only the top-k modeled survivors — in parallel, through the
+   persistent compile cache, so the winner's first real dispatch is a
+   disk retrieval and re-planning the same shapes is nearly free —
+   then re-rank on the compiled programs' REAL ``memory_analysis``
+   bytes and audited HLO wire bytes.
+
+Determinism contract: every ranking key is a pure function of the
+pickled inputs (config, avals, topology) — measured wall seconds are
+*recorded* in the report but never rank — so all ranks of an SPMD
+fleet running ``Trainer(strategy="auto")`` independently agree on the
+same winner without a collective.
+
+Per-trial plan reuse: inside a builtin tune experiment the report is
+memoized by (model fingerprint, topology, config); same-shaped trials
+reuse trial 0's plan outright, and their verify compiles would have
+been shared-cache hits anyway (tune/runner.py points all trials at one
+compile cache).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ray_lightning_tpu.plan.candidates import (Candidate,
+                                               enumerate_candidates,
+                                               policy_for_candidate)
+from ray_lightning_tpu.plan.config import PlanConfig
+from ray_lightning_tpu.plan.cost import (estimate_candidate, rank_key,
+                                         sharded_bytes)
+from ray_lightning_tpu.plan.report import PlanReport, make_entry
+
+_log = logging.getLogger(__name__)
+
+#: memoized reports for per-trial reuse (tune experiments only; guarded
+#: because the local tune runner executes trials in threads)
+_MEMO: dict = {}
+_MEMO_LOCK = threading.Lock()
+
+
+def clear_plan_memo() -> None:
+    """Drop memoized plans (tests; a new tune experiment gets fresh
+    plans anyway because the config/topology key changes)."""
+    with _MEMO_LOCK:
+        _MEMO.clear()
+
+
+def _tune_session_active() -> bool:
+    try:
+        from ray_lightning_tpu.tune.session import _get
+        return _get() is not None
+    except Exception:
+        return False
+
+
+def _batch_fingerprint(batch) -> tuple:
+    leaves, treedef = jax.tree_util.tree_flatten(batch)
+    return (str(treedef),
+            tuple((tuple(np.shape(x)), str(np.asarray(x).dtype))
+                  for x in leaves))
+
+
+@dataclasses.dataclass
+class _Built:
+    """Everything the scoring stage materialized for one candidate and
+    the verify stage reuses (nothing here is compiled)."""
+
+    candidate: Candidate
+    strategy: object
+    mesh: object
+    grad_sync: object
+    tx: object
+    abstract: object
+    shardings: object
+    estimate: object
+
+
+class Planner:
+    """Cost-model-driven auto-parallelism (module docstring)."""
+
+    def __init__(self, config=None):
+        self.config = PlanConfig.resolve(config)
+
+    # -- candidate materialization ----------------------------------------
+
+    def _build(self, module, cand: Candidate, devices, batch_hint,
+               example_batch, tx_factory, base_policy,
+               abstract_cache: dict):
+        strategy = cand.build_strategy()
+        mesh = strategy.build_mesh(devices, batch_hint=batch_hint)
+        policy = policy_for_candidate(cand, base_policy)
+        grad_sync = strategy.grad_transform(mesh, policy) \
+            if policy is not None else None
+        if cand.comm and grad_sync is None:
+            raise _Infeasible(
+                "comm_inert: the comm policy resolves to no compressible "
+                "axis on this mesh (comm/collectives.py build_grad_sync)")
+        # the abstract state depends only on the tx wrap (the CommState
+        # error-feedback residual adds [world, ...] leaves), not on the
+        # strategy — cache the eval_shape per (comm, world) so scoring
+        # dozens of candidates traces init O(distinct shapes) times
+        from ray_lightning_tpu.core.steps import build_init_fn
+        world = grad_sync.world if grad_sync is not None \
+            and hasattr(grad_sync, "world") else 0
+        key = (cand.comm, world)
+        tx = tx_factory(grad_sync)
+        if key not in abstract_cache:
+            abstract_cache[key] = jax.eval_shape(
+                build_init_fn(module, tx), jax.random.PRNGKey(0),
+                example_batch)
+        abstract = abstract_cache[key]
+        shardings = strategy.state_shardings(mesh, abstract)
+        if grad_sync is not None:
+            shardings = shardings.replace(
+                opt_state=grad_sync.fix_opt_shardings(
+                    shardings.opt_state, abstract.opt_state))
+        return strategy, mesh, grad_sync, tx, abstract, shardings
+
+    def _jitted_step(self, module, built: _Built, gb_abstract):
+        """The candidate's real train-step jit, wired exactly as the
+        trainer's ``_build_compiled`` would wire it."""
+        from ray_lightning_tpu.core.steps import build_train_step
+        cand = built.candidate
+        step = build_train_step(module, built.tx, cand.microbatch,
+                                grad_sync=built.grad_sync)
+        kw = dict(out_shardings=(built.shardings, None))
+        if cand.donate:
+            kw["donate_argnums"] = 0
+        if built.mesh.devices.size > 1:
+            kw["in_shardings"] = (
+                built.shardings,
+                built.strategy.batch_shardings(built.mesh, gb_abstract))
+        return jax.jit(step, **kw)
+
+    # -- the plan ----------------------------------------------------------
+
+    def plan(self, module, example_batch, *, devices=None,
+             batch_hint: Optional[int] = None,
+             process_count: Optional[int] = None,
+             base_comm_policy=None, tx_factory=None,
+             microbatch_options: Optional[tuple] = None) -> PlanReport:
+        """Pick a plan for training ``module`` on this topology.
+
+        ``example_batch`` is the (host-cast, process-local) peeked
+        batch; ``batch_hint`` the global batch size; ``tx_factory`` maps
+        a resolved GradSync (or None) to the optimizer transform — the
+        trainer passes its own ``_configure_tx`` so gradient clipping
+        and comm wrapping match the real run.  Raises ``ValueError``
+        naming every reason when no candidate survives.
+        """
+        t0 = time.monotonic()
+        cfg = self.config
+        devices = list(devices) if devices is not None else jax.devices()
+        pc = process_count if process_count is not None \
+            else jax.process_count()
+        if tx_factory is None:
+            def tx_factory(gs):
+                tx = module.configure_optimizers()
+                if isinstance(tx, dict):
+                    tx = tx["optimizer"]
+                return gs.wrap_tx(tx) if gs is not None else tx
+
+        memo_key = None
+        if cfg.reuse and _tune_session_active():
+            memo_key = (type(module).__qualname__,
+                        _batch_fingerprint(example_batch),
+                        len(devices), pc, batch_hint, cfg)
+            with _MEMO_LOCK:
+                hit = _MEMO.get(memo_key)
+            if hit is not None:
+                report = dataclasses.replace(
+                    hit, reused=True, cache_misses=0,
+                    plan_seconds=time.monotonic() - t0)
+                self._note_tune(report)
+                return report
+
+        comm_hint = base_comm_policy is not None and base_comm_policy.enabled
+        candidates, pruned = enumerate_candidates(
+            len(devices), batch_hint, cfg, process_count=pc,
+            microbatch_options=microbatch_options,
+            comm_enabled_hint=comm_hint)
+        entries = [make_entry(label, "pruned", reason)
+                   for label, reason in pruned]
+        if len(candidates) > cfg.max_candidates:
+            for cand in candidates[cfg.max_candidates:]:
+                entries.append(make_entry(
+                    cand, "pruned",
+                    f"max_candidates: enumeration capped at "
+                    f"{cfg.max_candidates} scored candidates"))
+            candidates = candidates[:cfg.max_candidates]
+
+        batch_bytes = sum(
+            int(np.asarray(leaf).nbytes)
+            for leaf in jax.tree_util.tree_leaves(example_batch)) * pc
+
+        # -- score (no compiles) ------------------------------------------
+        abstract_cache: dict = {}
+        built: list[_Built] = []
+        for cand in candidates:
+            try:
+                strategy, mesh, gs, tx, abstract, shardings = self._build(
+                    module, cand, devices, batch_hint, example_batch,
+                    tx_factory, base_comm_policy, abstract_cache)
+            except _Infeasible as e:
+                entries.append(make_entry(cand, "rejected", str(e)))
+                continue
+            except Exception as e:   # noqa: BLE001 - per-candidate soft
+                entries.append(make_entry(
+                    cand, "rejected",
+                    f"build_error: {type(e).__name__}: {e}"))
+                continue
+            est = estimate_candidate(cand, strategy, mesh, abstract,
+                                     shardings, batch_bytes, cfg, pc,
+                                     grad_sync=gs)
+            if not est.fits:
+                entries.append(make_entry(cand, "rejected", est.reason,
+                                          modeled=est.to_dict()))
+                continue
+            built.append(_Built(cand, strategy, mesh, gs, tx, abstract,
+                                shardings, est))
+
+        built.sort(key=lambda b: rank_key(b.candidate, b.estimate))
+
+        # -- verify (AOT-compile top-k through the persistent cache) ------
+        from ray_lightning_tpu.compile import cache as compile_cache
+        from ray_lightning_tpu.compile.aot import (compile_scored,
+                                                   global_batch_abstract)
+        gb_abstract = global_batch_abstract(example_batch, pc)
+        top = built[:cfg.topk] if cfg.topk > 0 else []
+        rest = built[len(top):]
+        misses_before = compile_cache.stats().misses
+        programs = []
+        for b in top:
+            try:
+                jitted = self._jitted_step(module, b, gb_abstract)
+            except Exception as e:   # noqa: BLE001 - per-candidate soft
+                entries.append(make_entry(
+                    b.candidate, "rejected",
+                    f"jit_error: {type(e).__name__}: {e}",
+                    modeled=b.estimate.to_dict()))
+                continue
+            programs.append((b.candidate.label, jitted,
+                             (b.abstract, gb_abstract),
+                             b.strategy.data_parallel_size(b.mesh)))
+        scored = compile_scored(programs)
+        cache_misses = compile_cache.stats().misses - misses_before
+
+        verified: list[tuple[tuple, _Built, dict]] = []
+        for b in top:
+            sc = scored.get(b.candidate.label)
+            if sc is None:
+                continue        # jit_error entry already recorded
+            if not sc.ok:
+                entries.append(make_entry(
+                    b.candidate, "rejected",
+                    f"compile_error: {sc.error}",
+                    modeled=b.estimate.to_dict(),
+                    measured=sc.to_dict()))
+                continue
+            budget = b.estimate.budget
+            if budget is not None and sc.peak_bytes \
+                    > cfg.headroom * budget:
+                entries.append(make_entry(
+                    b.candidate, "rejected",
+                    f"hbm_over_budget_measured: compiled peak "
+                    f"{sc.peak_bytes >> 20} MiB > "
+                    f"{int(cfg.headroom * budget) >> 20} MiB budget",
+                    modeled=b.estimate.to_dict(),
+                    measured=sc.to_dict()))
+                continue
+            from ray_lightning_tpu.comm.audit import bytes_to_seconds
+            gbps = cfg.dcn_gbps if pc > 1 else cfg.ici_gbps
+            audited_seconds = bytes_to_seconds(sc.wire_bytes, gbps)
+            mismatch = 0 if b.candidate.donate \
+                == b.estimate.donate_preferred else 1
+            key = (audited_seconds, mismatch, sc.peak_bytes,
+                   b.candidate.label)
+            measured = sc.to_dict()
+            measured["audited_seconds"] = audited_seconds
+            verified.append((key, b, measured))
+
+        verified.sort(key=lambda t: t[0])
+        winner: Optional[_Built] = None
+        winner_measured = None
+        if verified:
+            winner = verified[0][1]
+            winner_measured = verified[0][2]
+            for _, b, measured in verified[1:]:
+                entries.append(make_entry(b.candidate, "compiled",
+                                          modeled=b.estimate.to_dict(),
+                                          measured=measured))
+        elif rest or (built and cfg.topk == 0):
+            # verify stage produced nothing usable (topk=0, or every
+            # top-k compile failed/over-budget): fall back to the best
+            # remaining MODELED survivor rather than dying
+            fallback = rest if cfg.topk > 0 else built
+            winner = fallback[0]
+            rest = fallback[1:]
+            if cfg.topk > 0:
+                _log.warning(
+                    "plan: all top-%d verify candidates failed; falling "
+                    "back to the best un-verified modeled candidate %s",
+                    cfg.topk, winner.candidate.label)
+        for b in rest:
+            entries.append(make_entry(b.candidate, "scored",
+                                      modeled=b.estimate.to_dict()))
+
+        if winner is None:
+            reasons = "; ".join(
+                f"{e['label']}: {e['reason']}" for e in entries
+                if e.get("reason"))
+            raise ValueError(
+                "strategy='auto' found no feasible plan — every "
+                f"candidate was pruned or rejected: {reasons}")
+
+        entries.append(make_entry(winner.candidate, "winner",
+                                  modeled=winner.estimate.to_dict(),
+                                  measured=winner_measured))
+        report = PlanReport(
+            entries=entries,
+            winner_label=winner.candidate.label,
+            topk=cfg.topk,
+            plan_seconds=time.monotonic() - t0,
+            cache_misses=cache_misses,
+            winner_candidate=winner.candidate,
+            winner_policy=policy_for_candidate(winner.candidate,
+                                               base_comm_policy),
+        )
+        if memo_key is not None:
+            with _MEMO_LOCK:
+                _MEMO[memo_key] = report
+        self._note_tune(report)
+        return report
+
+    @staticmethod
+    def _note_tune(report: PlanReport) -> None:
+        try:
+            from ray_lightning_tpu.tune.session import note_plan_report
+            note_plan_report(report.to_dict())
+        except Exception:   # noqa: BLE001 - tune plane optional here
+            pass
+
+
+class _Infeasible(Exception):
+    """A candidate that cannot be materialized (named reason)."""

@@ -1,0 +1,144 @@
+"""Planner-plane selfcheck for ``format.sh --check`` (CI gate).
+
+Same contract as the comm/compile/serve/elastic selfchecks: cheap,
+deterministic, no pytest — validates the invariants that would
+otherwise only fail deep inside a planning run:
+
+1. ``PlanConfig`` validation + ``RLT_PLAN*`` env round-trip
+   (``worker_env`` → ``resolve`` reproduces the config);
+2. enumeration sanity: the canonical inventory appears, spmd mesh
+   factorizations are exact divisors, statically-infeasible combos are
+   pruned with named reasons, labels are unique;
+3. score monotonicity: ``bytes_to_seconds`` is strictly monotone in
+   bytes and inversely so in bandwidth (the ranking invariant);
+4. report schema: ``PlanReport.to_dict()`` carries every pinned key
+   and candidate entries carry the entry schema;
+5. every ``rlt_plan_*`` metric name is Prometheus-clean (the PR 2
+   lint).
+"""
+
+from __future__ import annotations
+
+
+def _check_config() -> None:
+    import os
+    from ray_lightning_tpu.plan.config import PlanConfig
+
+    cfg = PlanConfig(topk=2, ici_gbps=42.0, dcn_gbps=3.5,
+                     strategies=("ddp", "zero1"), microbatch=(1, 4),
+                     hbm_budget_bytes=1 << 30, headroom=0.8)
+    saved = {k: os.environ.get(k) for k in list(os.environ)
+             if k.startswith("RLT_PLAN")}
+    try:
+        for k in saved:
+            os.environ.pop(k, None)
+        os.environ.update(cfg.worker_env())
+        got = PlanConfig.resolve(None)
+        assert got == cfg, f"env round-trip drifted: {got} != {cfg}"
+    finally:
+        for k in list(os.environ):
+            if k.startswith("RLT_PLAN"):
+                os.environ.pop(k, None)
+        os.environ.update({k: v for k, v in saved.items() if v is not None})
+    assert PlanConfig.resolve(None) == PlanConfig()
+    for bad in (dict(topk=-1), dict(ici_gbps=0), dict(headroom=0),
+                dict(headroom=1.5), dict(strategies=("warp",)),
+                dict(microbatch=(0,)), dict(max_candidates=0)):
+        try:
+            PlanConfig(**bad)
+        except ValueError:
+            pass
+        else:
+            raise AssertionError(f"expected ValueError for {bad}")
+    print("plan selfcheck: config validation + env round-trip OK")
+
+
+def _check_enumeration() -> None:
+    from ray_lightning_tpu.plan.candidates import enumerate_candidates
+    from ray_lightning_tpu.plan.config import PlanConfig
+
+    cfg = PlanConfig(microbatch=(1, 2))
+    cands, pruned = enumerate_candidates(8, 16, cfg, process_count=2)
+    labels = [c.label for c in cands]
+    assert len(set(labels)) == len(labels), "duplicate candidate labels"
+    strategies = {c.strategy for c in cands}
+    assert strategies == {"ddp", "zero1", "fsdp", "spmd"}, strategies
+    spmd_meshes = {c.mesh_sizes["fsdp"] for c in cands
+                   if c.strategy == "spmd"}
+    assert spmd_meshes == {2, 4, 8}, spmd_meshes
+    assert any(c.comm for c in cands if c.strategy == "ddp")
+    assert not any(c.comm for c in cands if c.strategy == "fsdp")
+    reasons = {r for _, r in pruned}
+    assert any(r.startswith("comm_unsupported") for r in reasons), reasons
+    # microbatch 2 over 8 shards needs batch 16 to split 16/(8*2)=1: ok;
+    # a batch of 12 cannot divide across 8 shards at all
+    cands12, pruned12 = enumerate_candidates(8, 12, cfg, process_count=2)
+    assert any(r.startswith("batch_indivisible")
+               for _, r in pruned12), pruned12
+    # single-process: comm pruned with the no-DCN reason
+    _, pruned1p = enumerate_candidates(8, 16, cfg, process_count=1)
+    assert any(r.startswith("comm_no_dcn") for _, r in pruned1p)
+    print("plan selfcheck: enumeration coverage + pruning reasons OK")
+
+
+def _check_monotonicity() -> None:
+    from ray_lightning_tpu.comm.audit import bytes_to_seconds
+
+    prev = -1.0
+    for nbytes in (0, 1, 1024, 1 << 20, 1 << 30, 1 << 40):
+        s = bytes_to_seconds(nbytes, 12.5)
+        assert s > prev or nbytes == 0, (nbytes, s, prev)
+        prev = s
+    assert bytes_to_seconds(1 << 30, 100.0) \
+        < bytes_to_seconds(1 << 30, 12.5), "faster link must score lower"
+    assert bytes_to_seconds({"a": 512, "b": 512}, 1.0) \
+        == bytes_to_seconds(1024, 1.0), "dict form must sum"
+    print("plan selfcheck: byte→seconds monotone in bytes and bandwidth")
+
+
+def _check_report_schema() -> None:
+    from ray_lightning_tpu.plan.candidates import Candidate
+    from ray_lightning_tpu.plan.report import (ENTRY_KEYS, REPORT_KEYS,
+                                               PlanReport, make_entry)
+
+    cand = Candidate(strategy="ddp", axis_sizes=(("data", 8),))
+    entries = [
+        make_entry("zz:pruned", "pruned", "batch_indivisible: …"),
+        make_entry(cand, "rejected", "hbm_over_budget: …"),
+        make_entry(cand, "winner", modeled={"comm_seconds": 0.0},
+                   measured={"compile_seconds": 0.1}),
+    ]
+    d = PlanReport(entries=entries, winner_label=cand.label,
+                   topk=3, plan_seconds=0.5, cache_misses=1).to_dict()
+    for k in REPORT_KEYS:
+        assert k in d, f"report missing {k!r}"
+    for e in d["candidates"]:
+        for k in ENTRY_KEYS:
+            assert k in e, f"entry missing {k!r}: {e}"
+    assert d["enumerated"] == 3 and d["pruned"] == 1 \
+        and d["rejected"] == 1 and d["compiled"] == 1
+    assert d["winner"] == cand.label
+    print("plan selfcheck: report schema pinned")
+
+
+def _check_metric_names() -> None:
+    from ray_lightning_tpu.telemetry.metrics import validate_metric_name
+    for name in ("rlt_plan_candidates_total", "rlt_plan_pruned_total",
+                 "rlt_plan_rejected_total", "rlt_plan_compiled_total",
+                 "rlt_plan_seconds"):
+        validate_metric_name(name)
+    print("plan selfcheck: metric names Prometheus-clean")
+
+
+def _main(argv: list) -> int:
+    _check_config()
+    _check_enumeration()
+    _check_monotonicity()
+    _check_report_schema()
+    _check_metric_names()
+    return 0
+
+
+if __name__ == "__main__":   # pragma: no cover - exercised via format.sh
+    import sys
+    sys.exit(_main(sys.argv[1:]))

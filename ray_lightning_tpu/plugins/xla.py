@@ -126,6 +126,9 @@ def _worker_run(payload: tuple, rank: int, queue,
         # startup cost as rank 0 saw it (bench.py reports it; the
         # compile plane's cold/warm A/B is measured on this number)
         "time_to_first_step": trainer.time_to_first_step,
+        # the planner's verdict when strategy="auto" ran in the workers
+        # (every rank plans identically; rank 0's copy is THE report)
+        "plan_report": trainer._plan_report,
     }
     if stage == "fit":
         # Weights return in-band as a state stream — PL's temp-file
@@ -316,6 +319,11 @@ class RayXlaPlugin(ExecutionPlugin):
         base_env.update(trainer.comm_policy.worker_env())
         # elastic knobs too (RLT_ELASTIC* — elastic/config.py)
         base_env.update(trainer.elastic.worker_env())
+        # planner knobs (RLT_PLAN* — plan/config.py): the pickled
+        # trainer carries the resolved PlanConfig; the env keeps
+        # worker-side tooling consistent, and identical config on every
+        # rank is what the planner's deterministic-winner contract needs
+        base_env.update(trainer.plan.worker_env())
         from ray_lightning_tpu.core import datacheck
         if datacheck.enabled():
             # driver-set RLT_DATA_CHECK=1 reaches workers explicitly
@@ -525,6 +533,8 @@ class RayXlaPlugin(ExecutionPlugin):
         trainer.global_step = rank0.get("global_step", trainer.global_step)
         trainer.time_to_first_step = rank0.get("time_to_first_step")
         trainer._elastic_worker_stats = rank0.get("elastic")
+        if rank0.get("plan_report") is not None:
+            trainer._plan_report = rank0.get("plan_report")
         if stage == "fit":
             stream = rank0.get("state_stream")
             if stream is not None:

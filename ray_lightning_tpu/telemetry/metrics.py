@@ -88,6 +88,13 @@ CORE_METRICS = (
     "rlt_snapshot_stall_seconds_total",
     "rlt_restarts_total",
     "rlt_worker_alive",
+    # planner plane (core/trainer.py _resolve_auto_strategy gauges the
+    # PlanReport counts after a strategy="auto" resolution)
+    "rlt_plan_candidates_total",
+    "rlt_plan_pruned_total",
+    "rlt_plan_rejected_total",
+    "rlt_plan_compiled_total",
+    "rlt_plan_seconds",
 )
 
 

@@ -66,6 +66,12 @@ class Trial:
         #: populated at first acquire — tune/session.py) for post-hoc
         #: "which chips ran this trial" debugging via ExperimentAnalysis
         self.leased_devices: list[str] = []
+        #: PlanReport dict of a Trainer(strategy="auto") run inside
+        #: this trial (tune/session.py note_plan_report) — which plan
+        #: each trial trained under, for post-hoc sweep analysis; trial
+        #: N>0 of a same-shaped sweep reuses trial 0's plan via the
+        #: planner memo + the experiment's shared compile cache
+        self.plan_report: Optional[dict] = None
 
     def __repr__(self):
         return f"Trial({self.trial_id}, {self.status})"

@@ -88,6 +88,16 @@ def in_session() -> bool:
     return _get() is not None
 
 
+def note_plan_report(report: dict) -> None:
+    """Record the planner's PlanReport dict on the live trial (no-op
+    outside a trial) — the post-hoc "which plan did this trial train
+    under" analog of ``leased_devices`` / ``metrics_url``.  Called by
+    plan/planner.py after every (including memo-reused) plan."""
+    s = _get()
+    if s is not None:
+        s.trial.plan_report = report
+
+
 def report(_metrics: Optional[dict] = None, **metrics) -> None:
     """Report metrics for the current trial (``tune.report`` analog).
 

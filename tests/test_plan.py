@@ -1,0 +1,373 @@
+"""Planner plane (ray_lightning_tpu/plan/): enumeration, cost-model
+scoring, top-k AOT verification, and ``Trainer(strategy="auto")``
+end-to-end — plus the model-drift guard pinning each strategy's
+declared ``step_collective_bytes`` against the audited HLO wire bytes
+of its actually-lowered train step, so the planner's inputs can't
+silently rot.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.comm import CommPolicy
+from ray_lightning_tpu.comm.audit import total_wire_bytes
+from ray_lightning_tpu.compile import cache as compile_cache
+from ray_lightning_tpu.core.steps import build_init_fn, build_train_step
+from ray_lightning_tpu.models.boring import BoringModel
+from ray_lightning_tpu.plan import (Candidate, PlanConfig, Planner,
+                                    clear_plan_memo, enumerate_candidates,
+                                    estimate_candidate)
+from ray_lightning_tpu.parallel.strategy import resolve_strategy
+
+BATCH = 16
+
+
+def _boring():
+    module = BoringModel(batch_size=BATCH, dataset_length=4 * BATCH)
+    module.prepare_data()
+    module.setup("fit")
+    module.setup_model()
+    return module
+
+
+def _example_batch(module):
+    return jax.tree_util.tree_map(
+        np.asarray, next(iter(module.train_dataloader())))
+
+
+# -- enumeration -----------------------------------------------------------
+
+def test_enumeration_covers_inventory():
+    cfg = PlanConfig(microbatch=(1, 2))
+    cands, _ = enumerate_candidates(8, 16, cfg, process_count=2)
+    by_strategy = {c.strategy for c in cands}
+    assert by_strategy == {"ddp", "zero1", "fsdp", "spmd"}
+    # spmd enumerates every data×fsdp divisor factorization
+    assert {c.mesh_sizes["fsdp"] for c in cands if c.strategy == "spmd"} \
+        == {2, 4, 8}
+    # comm rides only the compressible strategies
+    assert {c.strategy for c in cands if c.comm} == {"ddp", "zero1"}
+    # donation and microbatch double the feasible combinations
+    assert any(not c.donate for c in cands)
+    assert any(c.microbatch == 2 for c in cands)
+    # labels are unique (the report keys on them)
+    labels = [c.label for c in cands]
+    assert len(set(labels)) == len(labels)
+
+
+def test_enumeration_prunes_with_named_reasons():
+    cfg = PlanConfig(microbatch=(1, 4))
+    # batch 8 over 8 shards: microbatch 4 cannot split 8/(8*4)
+    _, pruned = enumerate_candidates(8, 8, cfg, process_count=2)
+    reasons = {r.split(":")[0] for _, r in pruned}
+    assert "microbatch_indivisible" in reasons, pruned
+    assert "comm_unsupported" in reasons, pruned    # fsdp/spmd × comm
+    # batch 12 cannot divide across 8 shards at all
+    _, pruned12 = enumerate_candidates(8, 12, cfg, process_count=2)
+    assert any(r.startswith("batch_indivisible") for _, r in pruned12)
+    # single process: no DCN hop, comm pruned by name
+    _, pruned1p = enumerate_candidates(8, 16, cfg, process_count=1)
+    assert any(r.startswith("comm_no_dcn") for _, r in pruned1p)
+    # every pruned entry names a candidate label AND a reason
+    for label, reason in pruned + pruned12 + pruned1p:
+        assert label and reason
+
+
+# -- cost model ------------------------------------------------------------
+
+def _fixture_scoring(strategy_name="ddp", donate=True, budget=None):
+    module = _boring()
+    batch = _example_batch(module)
+    cand = Candidate(strategy=strategy_name, axis_sizes=(("data", 8),),
+                     donate=donate)
+    strategy = cand.build_strategy()
+    mesh = strategy.build_mesh(batch_hint=BATCH)
+    tx = module.configure_optimizers()
+    abstract = jax.eval_shape(build_init_fn(module, tx),
+                              jax.random.PRNGKey(0), batch)
+    shardings = strategy.state_shardings(mesh, abstract)
+    cfg = PlanConfig(hbm_budget_bytes=budget)
+    batch_bytes = sum(np.asarray(x).nbytes
+                      for x in jax.tree_util.tree_leaves(batch))
+    return estimate_candidate(cand, strategy, mesh, abstract, shardings,
+                              batch_bytes, cfg, process_count=1)
+
+
+def test_over_budget_rejected_with_named_reason():
+    est = _fixture_scoring(budget=1024)       # 1 KiB: nothing fits
+    assert not est.fits
+    assert est.reason.startswith("hbm_over_budget"), est.reason
+    assert "MiB" in est.reason and "budget" in est.reason
+    # a roomy budget accepts the same candidate
+    assert _fixture_scoring(budget=1 << 30).fits
+
+
+def test_undonated_peak_models_second_state_copy():
+    donated = _fixture_scoring(donate=True, budget=1 << 30)
+    undonated = _fixture_scoring(donate=False, budget=1 << 30)
+    assert undonated.peak_bytes - donated.peak_bytes \
+        == donated.state_bytes
+
+
+def test_planner_raises_naming_reasons_when_nothing_fits():
+    module = _boring()
+    batch = _example_batch(module)
+    planner = Planner(PlanConfig(hbm_budget_bytes=1024, topk=0))
+    with pytest.raises(ValueError, match="hbm_over_budget"):
+        planner.plan(module, batch, batch_hint=BATCH)
+
+
+def test_ranking_deterministic_and_reports_everything():
+    module = _boring()
+    batch = _example_batch(module)
+    r1 = Planner(PlanConfig(topk=0)).plan(module, batch, batch_hint=BATCH)
+    r2 = Planner(PlanConfig(topk=0)).plan(module, batch, batch_hint=BATCH)
+    d1, d2 = r1.to_dict(), r2.to_dict()
+    assert d1["winner"] == d2["winner"]
+    assert [e["label"] for e in d1["candidates"]] \
+        == [e["label"] for e in d2["candidates"]]
+    # every pruned/rejected entry carries its named reason
+    for e in d1["candidates"]:
+        if e["status"] in ("pruned", "rejected"):
+            assert e["reason"], e
+    # a tiny replicated model on a fast all-ICI mesh: DDP's single psum
+    # beats the sharded strategies' gather traffic
+    assert d1["winner"] == "ddp[data8]"
+
+
+# -- top-k AOT verification (compile-cache counters) -----------------------
+
+def test_topk_bounds_aot_compiles(tmp_path):
+    module = _boring()
+    batch = _example_batch(module)
+    compile_cache.activate(compile_cache.CompileCacheConfig(
+        enabled=True, dir=str(tmp_path / "cc")))
+    try:
+        compile_cache.reset_stats()
+        report = Planner(PlanConfig(topk=2)).plan(module, batch,
+                                                  batch_hint=BATCH)
+        d = report.to_dict()
+        assert d["compiled"] <= 2
+        assert d["cache_misses"] <= 2, d["cache_misses"]
+        assert d["winner"] is not None
+        # re-planning the same shapes through the same cache compiles
+        # nothing: every verify program is a disk hit
+        report2 = Planner(PlanConfig(topk=2)).plan(module, batch,
+                                                   batch_hint=BATCH)
+        assert report2.to_dict()["cache_misses"] == 0
+        assert report2.winner_label == report.winner_label
+    finally:
+        compile_cache.deactivate()
+        compile_cache.reset_stats()
+
+
+# -- strategy="auto" end-to-end --------------------------------------------
+
+def _fit_trainer(tmp_path, name, **kw):
+    from ray_lightning_tpu import Trainer
+    return Trainer(
+        default_root_dir=str(tmp_path / name), max_epochs=1,
+        enable_checkpointing=False, num_sanity_val_steps=0,
+        limit_val_batches=0, log_every_n_steps=10**9, seed=0, **kw)
+
+
+def test_auto_end_to_end_matches_hand_picked(tmp_path, seed):
+    """``strategy="auto"`` trains to completion and its final params
+    equal the same plan hand-picked (BoringModel is deterministic:
+    uses_rng=False, plain SGD)."""
+    auto = _fit_trainer(tmp_path, "auto", strategy="auto", max_steps=4)
+    m_auto = BoringModel(batch_size=BATCH, dataset_length=4 * BATCH)
+    auto.fit(m_auto)
+    assert auto.global_step == 4
+    d = auto._plan_report
+    assert d is not None and d["winner"] == "ddp[data8]"
+    assert auto.strategy.name == "ddp"
+    for e in d["candidates"]:
+        if e["status"] in ("pruned", "rejected"):
+            assert e["reason"], e
+
+    hand = _fit_trainer(tmp_path, "hand", strategy="ddp", max_steps=4)
+    m_hand = BoringModel(batch_size=BATCH, dataset_length=4 * BATCH)
+    hand.fit(m_hand)
+    assert hand._plan_report is None
+    for a, b in zip(
+            jax.tree_util.tree_leaves(m_auto._trained_variables),
+            jax.tree_util.tree_leaves(m_hand._trained_variables)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_auto_end_to_end_two_workers(tmp_path, seed):
+    """The acceptance leg: ``strategy="auto"`` on a 2-worker CPU mesh —
+    every rank plans independently and deterministically, the fleet
+    trains to max_steps in lockstep under the winner, rank-0's
+    PlanReport rides back to the driver, and the result matches the
+    same plan hand-picked."""
+    from tests.utils import cpu_plugin
+
+    auto = _fit_trainer(tmp_path, "auto", strategy="auto",
+                        plugins=[cpu_plugin(2)])
+    m_auto = BoringModel(batch_size=BATCH, dataset_length=4 * BATCH)
+    auto.fit(m_auto)
+    assert auto.global_step == 2      # 64 samples over 2 workers
+    d = auto._plan_report
+    assert d is not None and d["winner"] == "ddp[data2]"
+    # param-sharded strategies' comm candidates pruned by name
+    pruned = {e["label"]: e["reason"] for e in d["candidates"]
+              if e["status"] == "pruned"}
+    assert any(r.startswith("comm_unsupported") for r in pruned.values())
+
+    hand = _fit_trainer(tmp_path, "hand", strategy="ddp",
+                        plugins=[cpu_plugin(2)])
+    m_hand = BoringModel(batch_size=BATCH, dataset_length=4 * BATCH)
+    hand.fit(m_hand)
+    for a, b in zip(
+            jax.tree_util.tree_leaves(m_auto._trained_variables),
+            jax.tree_util.tree_leaves(m_hand._trained_variables)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_auto_reuses_plan_inside_tune_trial(tmp_path):
+    """Per-trial plan reuse: the second same-shaped plan inside a tune
+    session is the memoized report (reused flag, zero compiles), and
+    the report lands on the trial for post-hoc analysis."""
+    from ray_lightning_tpu.tune.runner import Trial
+    from ray_lightning_tpu.tune.session import TrialSession, set_session
+
+    module = _boring()
+    batch = _example_batch(module)
+    clear_plan_memo()
+    trial = Trial("t0", {}, str(tmp_path))
+    set_session(TrialSession(trial, lambda t, m: None))
+    try:
+        r1 = Planner(PlanConfig(topk=0)).plan(module, batch,
+                                              batch_hint=BATCH)
+        assert not r1.reused
+        r2 = Planner(PlanConfig(topk=0)).plan(module, batch,
+                                              batch_hint=BATCH)
+        assert r2.reused and r2.winner_label == r1.winner_label
+        assert trial.plan_report is not None
+        assert trial.plan_report["winner"] == r1.winner_label
+        assert trial.plan_report["reused"]
+    finally:
+        set_session(None)
+        clear_plan_memo()
+
+
+# -- resolve_strategy surface (satellite: docstring/README drift) ----------
+
+def test_resolve_strategy_unknown_name_lists_valid_set():
+    with pytest.raises(ValueError) as ei:
+        resolve_strategy("warpdrive")
+    msg = str(ei.value)
+    for name in ("ddp", "zero1", "fsdp", "spmd", "auto", "sharded"):
+        assert name in msg, msg
+
+
+def test_resolve_auto_returns_sentinel():
+    auto = resolve_strategy("auto")
+    assert auto.name == "auto"
+    with pytest.raises(RuntimeError, match="planner"):
+        auto.build_mesh()
+
+
+# -- model-drift guard: declared bytes vs audited HLO ----------------------
+
+@pytest.fixture(scope="module")
+def drift_programs():
+    """Compile the REAL train step for (ddp, zero1) × (comm off, int8)
+    on the 8-device mesh; yield declared step_collective_bytes next to
+    the audited HLO wire bytes of the same lowered program."""
+    from ray_lightning_tpu.models.gpt import GPTLightningModule
+
+    out = {}
+    for name in ("ddp", "zero1"):
+        for comm in (False, True):
+            module = GPTLightningModule("tiny", dataset_size=4 * BATCH,
+                                        batch_size=BATCH)
+            module.setup_model()
+            strat = resolve_strategy(name)
+            mesh = strat.build_mesh(batch_hint=BATCH)
+            policy = CommPolicy(compress="int8", axes=("data",)) \
+                if comm else None
+            sync = strat.grad_transform(mesh, policy) if comm else None
+            tx = module.configure_optimizers()
+            if sync is not None:
+                tx = sync.wrap_tx(tx)
+            batch = jax.tree_util.tree_map(
+                np.asarray, next(iter(module.train_dataloader())))
+            abstract = jax.eval_shape(build_init_fn(module, tx),
+                                      jax.random.PRNGKey(0), batch)
+            shardings = strat.state_shardings(mesh, abstract)
+            if sync is not None:
+                shardings = shardings.replace(
+                    opt_state=sync.fix_opt_shardings(
+                        shardings.opt_state, abstract.opt_state))
+            jitted = jax.jit(
+                build_train_step(module, tx, grad_sync=sync),
+                donate_argnums=0,
+                in_shardings=(shardings,
+                              strat.batch_shardings(mesh, batch)),
+                out_shardings=(shardings, None))
+            compiled = jitted.lower(abstract, batch).compile()
+            out[(name, comm)] = {
+                "declared": strat.step_collective_bytes(mesh, abstract,
+                                                        comm=sync),
+                "text": compiled.as_text(),
+            }
+    return out
+
+
+def test_drift_ddp_uncompressed(drift_programs):
+    """DDP declares one grad all-reduce the size of the (bf16-resident)
+    params.  The audited program moves more: grads ride the wire at f32
+    (2× the bf16 declaration — the partitioner resolves partial sums at
+    the f32 grad dots, tests/test_collective_audit.py), the all-reduce
+    wire factor is 2× (reduce-scatter + all-gather phases), and the
+    partitioner inserts ~25% extra reductions beyond the logical grad
+    sum — measured 4.96× on this toolchain.  The band pins that
+    calibration: either side silently halving or doubling leaves it."""
+    p = drift_programs[("ddp", False)]
+    declared = sum(p["declared"].values())
+    audited = total_wire_bytes(p["text"], axis_size=8,
+                               ops=("all-reduce",))
+    assert 3.5 <= audited / declared <= 6.5, (audited, declared)
+
+
+def test_drift_zero1_uncompressed(drift_programs):
+    """ZeRO-1 declares grad reduce-scatter + param all-gather (one
+    params' worth each, at residency dtype).  Audited: the CPU lowering
+    spells the grad phase as f32 all-reduce + dynamic-slice (see
+    Zero1Strategy's docstring) and the param gather at the param dtype —
+    measured 3.48× the declaration on this toolchain (same f32-wire ×
+    all-reduce-factor composition as the DDP leg).  Band pins the
+    calibration against silent 2× rot on either side."""
+    p = drift_programs[("zero1", False)]
+    declared = sum(p["declared"].values())
+    audited = total_wire_bytes(
+        p["text"], axis_size=8,
+        ops=("all-reduce", "all-gather", "reduce-scatter"))
+    assert 2.4 <= audited / declared <= 4.6, (audited, declared)
+
+
+@pytest.mark.parametrize("name", ["ddp", "zero1"])
+def test_drift_compressed_declaration_tracks_audit(drift_programs, name):
+    """With comm=int8 the declaration IS the compressed wire payload
+    (quant.payload_bytes) and the program's collectives are the comm
+    plane's own manual lowering — so declared and audited agree far
+    more tightly than the partitioner legs (measured 1.05× ddp, 1.51×
+    zero1: the slack is ZeRO-1's uncompressed param gather riding
+    partitioner spelling).  Also re-pins that the compressed program
+    moves ≥2× fewer audited bytes than the flat one — the saving the
+    planner's comm dimension exists to exploit."""
+    comp = drift_programs[(name, True)]
+    flat = drift_programs[(name, False)]
+    declared_c = sum(comp["declared"].values())
+    audited_c = total_wire_bytes(comp["text"], axis_size=8)
+    audited_f = total_wire_bytes(flat["text"], axis_size=8)
+    assert 0.7 <= audited_c / declared_c <= 2.0, (audited_c, declared_c)
+    assert audited_c * 2.0 <= audited_f, (audited_c, audited_f)
