@@ -10,6 +10,12 @@ emits ONE ``serve`` JSON line with the acceptance numbers:
 - ``tpot_p50_ms``      — steady decode time per output token
 - ``batch_occupancy``  — mean live-slot fraction per decode step
 - ``compile_cache``    — hit|miss|off (the compiled-once evidence)
+- ``tracing``          — whether per-request tracing was live for the
+  timed leg, plus ``per_tenant`` queue-wait p99 / decode attribution
+  (trace plane, ISSUE 9) so the tracing overhead target (<2% tokens/s)
+  is pinned in the bench trajectory
+- ``RLT_SERVE_TRACE_AB=1`` adds a second timed leg with telemetry off
+  and reports ``trace_overhead_pct`` directly
 
     python -m benchmarks.bench_serve [--requests N] [--slots S]
 """
@@ -24,6 +30,45 @@ import time
 import numpy as np
 
 
+def _percentile_ms(vals) -> "dict[str, float]":
+    arr = np.asarray([v for v in vals if v is not None], dtype=float)
+    if not len(arr):
+        return {}
+    return {"p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 3)}
+
+
+def _run_leg(module, *, telemetry, requests, slots, max_new_tokens,
+             buckets, num_workers, platform, vocab_size, root):
+    """One timed serve leg; returns (wall_s, reqs, stats)."""
+    from ray_lightning_tpu.serve import Server
+    server = Server(
+        module,
+        num_workers=num_workers, platform=platform,
+        buckets=buckets, max_batch_slots=slots,
+        max_new_tokens=max_new_tokens,
+        default_root_dir=root,
+        compile_cache=None,   # RLT_COMPILE_CACHE* env knobs apply
+        telemetry=telemetry,
+    ).start()
+    rng = np.random.default_rng(0)
+    tenants = ("alice", "bob", "carol")
+    try:
+        t0 = time.monotonic()
+        reqs = []
+        for i in range(requests):
+            n = int(rng.integers(4, min(buckets[-1], 48)))
+            prompt = rng.integers(1, vocab_size, size=n)
+            reqs.append(server.submit(prompt,
+                                      tenant=tenants[i % len(tenants)]))
+        outs = [r.result(timeout=600) for r in reqs]
+        wall = time.monotonic() - t0
+    finally:
+        stats = server.stats()
+        server.shutdown()
+    return wall, reqs, outs, stats
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--requests", type=int, default=24)
@@ -34,39 +79,21 @@ def main() -> None:
 
     from ray_lightning_tpu.compile import cache as compile_cache
     from ray_lightning_tpu.models.gpt import CONFIGS, GPTLightningModule
-    from ray_lightning_tpu.serve import Server
 
     cfg = CONFIGS[args.config]
     num_workers = int(os.environ.get("RLT_SERVE_WORKERS", "2"))
     platform = os.environ.get("RLT_SERVE_PLATFORM", "cpu")
     buckets = tuple(b for b in (16, 32, 64, 128, 256)
                     if b <= cfg.block_size) or (cfg.block_size,)
+    root = os.environ.get("RLT_SERVE_DIR", "rlt_serve")
+    leg = dict(requests=args.requests, slots=args.slots,
+               max_new_tokens=args.max_new_tokens, buckets=buckets,
+               num_workers=num_workers, platform=platform,
+               vocab_size=cfg.vocab_size, root=root)
 
-    server = Server(
+    wall, reqs, outs, stats = _run_leg(
         GPTLightningModule(args.config),
-        num_workers=num_workers, platform=platform,
-        buckets=buckets, max_batch_slots=args.slots,
-        max_new_tokens=args.max_new_tokens,
-        default_root_dir=os.environ.get("RLT_SERVE_DIR", "rlt_serve"),
-        compile_cache=None,   # RLT_COMPILE_CACHE* env knobs apply
-        telemetry={"metrics_port": 0},
-    ).start()
-
-    rng = np.random.default_rng(0)
-    tenants = ("alice", "bob", "carol")
-    try:
-        t0 = time.monotonic()
-        reqs = []
-        for i in range(args.requests):
-            n = int(rng.integers(4, min(buckets[-1], 48)))
-            prompt = rng.integers(1, cfg.vocab_size, size=n)
-            reqs.append(server.submit(prompt,
-                                      tenant=tenants[i % len(tenants)]))
-        outs = [r.result(timeout=600) for r in reqs]
-        wall = time.monotonic() - t0
-    finally:
-        stats = server.stats()
-        server.shutdown()
+        telemetry={"metrics_port": 0}, **leg)
 
     total_tokens = sum(len(o) for o in outs)
     ttfts = np.asarray([r.ttft_s for r in reqs]) * 1e3
@@ -76,27 +103,63 @@ def main() -> None:
     workers = stats.get("workers", [])
     retraces = (max(sum(w["retraces"].values()) for w in workers)
                 if workers else None)
+
+    # per-tenant latency attribution (trace plane): queue-wait p99 and
+    # the decode share of total request latency, from the request
+    # handles' phase stamps — the same numbers /status serves live
+    per_tenant: dict = {}
+    for r in reqs:
+        per_tenant.setdefault(r.tenant, []).append(r)
+    tenant_rows = {}
+    for tenant, rs in sorted(per_tenant.items()):
+        queue = _percentile_ms(r.queue_wait_s for r in rs)
+        decode = _percentile_ms(r.decode_s for r in rs)
+        shares = [r.decode_s / (r.t_done - r.t_submit) for r in rs
+                  if r.decode_s is not None and r.t_done > r.t_submit]
+        tenant_rows[tenant] = {
+            "requests": len(rs),
+            "queue_wait_p99_ms": queue.get("p99_ms"),
+            "decode_p50_ms": decode.get("p50_ms"),
+            "decode_attribution": (round(sum(shares) / len(shares), 3)
+                                   if shares else None),
+        }
+
+    serve = {
+        "tokens_per_sec": round(total_tokens / wall, 2),
+        "requests": len(reqs),
+        "total_tokens": int(total_tokens),
+        "wall_s": round(wall, 2),
+        "ttft_p50_ms": round(float(np.percentile(ttfts, 50)), 2),
+        "ttft_p99_ms": round(float(np.percentile(ttfts, 99)), 2),
+        "tpot_p50_ms": (round(float(np.percentile(tpots, 50)), 2)
+                        if len(tpots) else None),
+        "batch_occupancy": round(sched["batch_occupancy"], 3),
+        "tenants": len(tenant_rows),
+        "workers": num_workers,
+        "slots": args.slots,
+        "buckets": list(buckets),
+        "retraces_after_warmup": retraces,
+        "compile_cache": compile_cache.status_word(),
+        "tracing": True,
+        "per_tenant": tenant_rows,
+    }
+
+    if os.environ.get("RLT_SERVE_TRACE_AB") == "1":
+        # A/B leg with telemetry (and therefore per-request tracing)
+        # fully off: pins the tracing overhead directly instead of
+        # across bench rounds (target: <2% tokens/s)
+        wall_off, _reqs2, outs2, _stats2 = _run_leg(
+            GPTLightningModule(args.config), telemetry=False, **leg)
+        tps_off = sum(len(o) for o in outs2) / wall_off
+        serve["tokens_per_sec_tracing_off"] = round(tps_off, 2)
+        serve["trace_overhead_pct"] = round(
+            (tps_off - serve["tokens_per_sec"]) / tps_off * 100.0, 2)
+
     line = {
         "metric": "serve",
-        "value": round(total_tokens / wall, 2),
+        "value": serve["tokens_per_sec"],
         "unit": "tokens/s",
-        "serve": {
-            "tokens_per_sec": round(total_tokens / wall, 2),
-            "requests": len(reqs),
-            "total_tokens": int(total_tokens),
-            "wall_s": round(wall, 2),
-            "ttft_p50_ms": round(float(np.percentile(ttfts, 50)), 2),
-            "ttft_p99_ms": round(float(np.percentile(ttfts, 99)), 2),
-            "tpot_p50_ms": (round(float(np.percentile(tpots, 50)), 2)
-                            if len(tpots) else None),
-            "batch_occupancy": round(sched["batch_occupancy"], 3),
-            "tenants": len(tenants),
-            "workers": num_workers,
-            "slots": args.slots,
-            "buckets": list(buckets),
-            "retraces_after_warmup": retraces,
-            "compile_cache": compile_cache.status_word(),
-        },
+        "serve": serve,
     }
     print(json.dumps(line), flush=True)
     assert sched["completed"] == len(reqs), sched

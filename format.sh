@@ -67,6 +67,13 @@ if [[ "$CHECK" == 1 ]]; then
     # (ray_lightning_tpu/plan/selfcheck.py)
     python -c 'import sys; from ray_lightning_tpu.plan.selfcheck \
         import _main; sys.exit(_main([]))'
+    # trace-plane selfcheck: span-record schema, trace-context
+    # round-trip (driver + worker spans reassemble one request tree),
+    # flight-recorder bounded-size invariant, profile-controller state
+    # machine, trace-plane metric names
+    # (ray_lightning_tpu/telemetry/selfcheck.py)
+    python -c 'import sys; from ray_lightning_tpu.telemetry.selfcheck \
+        import _main; sys.exit(_main([]))'
 fi
 
 if [[ "$ALL" == 1 ]]; then

@@ -76,6 +76,14 @@ class ActorHandle:
         failure into a restartable death.  None when unknown."""
         return None
 
+    def log_tail(self, max_bytes: int = 4096) -> str:
+        """Tail of the worker's captured output for forensic context —
+        the crash flight recorder (telemetry/flight.py) attaches it to
+        ``flight_<rank>.json`` so the dead rank's own log lines sit
+        next to its last spans.  Empty when the backend does not
+        capture worker output (real Ray surfaces logs its own way)."""
+        return ""
+
 
 class ClusterBackend:
     """Actor lifecycle + object transport + worker→driver queue."""

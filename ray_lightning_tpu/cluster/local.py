@@ -96,20 +96,12 @@ class LocalActorHandle(ActorHandle):
         self.last_frame_at: Optional[float] = None
 
     def _log_tail(self, max_bytes: int = 4096) -> str:
-        """Tail of the worker's captured output, for failure diagnostics
-        (Ray surfaces worker logs the same way)."""
-        if not self.log_path:
-            return ""
-        try:
-            with open(self.log_path, "rb") as f:
-                f.seek(0, os.SEEK_END)
-                size = f.tell()
-                f.seek(max(0, size - max_bytes))
-                tail = f.read().decode(errors="replace").strip()
-            return f"\n--- worker log tail ({self.log_path}) ---\n{tail}" \
-                if tail else ""
-        except OSError:
-            return ""
+        """Banner-framed tail of the worker's captured output, for
+        failure-error messages (Ray surfaces worker logs the same way);
+        ``log_tail`` below is the raw-forensics flavor."""
+        tail = self.log_tail(max_bytes)
+        return f"\n--- worker log tail ({self.log_path}) ---\n{tail}" \
+            if tail else ""
 
     # -- wiring (called by backend accept loop) -------------------------
 
@@ -187,6 +179,20 @@ class LocalActorHandle(ActorHandle):
         except (ConnectionError, OSError) as e:
             self._fail_pending(RemoteActorError(str(e)))
         return fut
+
+    def log_tail(self, max_bytes: int = 4096) -> str:
+        """Raw tail of the captured worker log (no banner — the flight
+        recorder stores it as its own JSON field)."""
+        if not self.log_path:
+            return ""
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - max_bytes))
+                return f.read().decode(errors="replace").strip()
+        except OSError:
+            return ""
 
     def alive(self) -> Optional[bool]:
         if self._proc is None:

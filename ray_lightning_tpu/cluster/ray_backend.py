@@ -153,6 +153,19 @@ class RayActorHandle(ActorHandle):
         except Exception:
             return None
 
+    def log_tail(self, max_bytes: int = 4096) -> str:
+        """Best-effort worker-log forensics for the crash flight
+        recorder (telemetry/flight.py): the state API's log fetch when
+        this Ray build has one (driver-colocated clusters), else empty
+        — Ray's own log aggregation remains the canonical path."""
+        try:
+            from ray.util.state import get_log
+            lines = list(get_log(actor_id=self.actor_id, tail=60))
+            text = "\n".join(str(ln) for ln in lines).strip()
+            return text[-max_bytes:]
+        except Exception:
+            return ""
+
 
 class RayBackend(ClusterBackend):
     supports_object_store = True

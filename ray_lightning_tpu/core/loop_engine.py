@@ -46,6 +46,7 @@ import numpy as np
 
 from ray_lightning_tpu.telemetry import span
 from ray_lightning_tpu.telemetry import metrics as _metrics
+from ray_lightning_tpu.telemetry.tracing import profile_tick
 
 _log = logging.getLogger(__name__)
 
@@ -202,6 +203,9 @@ class StreamSource:
         return all(s == shapes[0] for s in shapes)
 
     def run_one(self, trainer, item: Item):
+        # on-demand profile window (POST /debug/profile → control file,
+        # telemetry/tracing.py): one global check when disarmed
+        profile_tick()
         if item.device is not None:
             gbatch = item.device
         else:
@@ -210,6 +214,7 @@ class StreamSource:
         return metrics
 
     def run_chunk(self, trainer, items: list):
+        profile_tick()
         stacked = jax.tree_util.tree_map(
             lambda *xs: np.stack(xs), *[it.payload for it in items])
         gbatch = trainer._put_batch(stacked, self._strategy, stacked=True)
@@ -554,6 +559,7 @@ class CachedSource:
         return all(it.kind == "cached" for it in items)
 
     def run_one(self, trainer, item: Item):
+        profile_tick()
         if item.kind == "host":
             gbatch = trainer._put_batch(item.payload, self._strategy)
             trainer.state, metrics = trainer._train_step(
@@ -564,6 +570,7 @@ class CachedSource:
         return metrics
 
     def run_chunk(self, trainer, items: list):
+        profile_tick()
         idxs = np.asarray([it.payload for it in items], dtype=np.int32)
         trainer.state, metrics = trainer._cached_multi_step(
             trainer.state, self._repacked, idxs)

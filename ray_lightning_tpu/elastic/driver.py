@@ -59,6 +59,28 @@ def _restartable(err: BaseException, dead_ranks: list) -> bool:
     return any(m in msg for m in _DEATH_MARKERS)
 
 
+def _dump_flights(plugin, err: BaseException, dead_ranks: list) -> None:
+    """Black-box dumps at death-classification time (telemetry/
+    flight.py): the classified cause lands in ``flight_<rank>.json``
+    next to each dead rank's last spans/heartbeats, so the postmortem
+    starts from evidence instead of the silent gap a torn-down fleet
+    otherwise leaves.  Falls back to every known rank when the probe
+    could not name the dead one (the cause still says why).  No-op
+    without telemetry; never raises into failure handling."""
+    agg = getattr(plugin, "_telemetry_agg", None)
+    if agg is None:
+        return
+    try:
+        cause = (f"elastic death classification: {type(err).__name__}: "
+                 f"{str(err).splitlines()[0][:300]}"
+                 f" (dead ranks {dead_ranks or 'unknown'})")
+        ranks = dead_ranks or agg.flight.ranks()
+        agg.dump_flights([r for r in ranks if r >= 0], cause)
+    except Exception:
+        _log.warning("flight dump at death classification failed",
+                     exc_info=True)
+
+
 def latest_snapshot_step(directory: str) -> Optional[int]:
     """Latest COMMITTED snapshot step under ``directory`` (None when
     the directory is empty or absent)."""
@@ -95,6 +117,7 @@ def run_elastic_fit(plugin, trainer, module, datamodule,
                                          "fit", ckpt_path)
         except BaseException as err:   # noqa: BLE001 - classified below
             dead = list(getattr(plugin, "_last_dead_ranks", ()) or ())
+            _dump_flights(plugin, err, dead)
             if not _restartable(err, dead):
                 raise
             restarts += 1

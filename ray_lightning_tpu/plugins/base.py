@@ -46,20 +46,35 @@ class LocalPlugin(ExecutionPlugin):
         # single-process run: recorder and aggregator share the process,
         # so the span/metrics sinks feed the aggregator directly (no
         # queue hop)
+        import os
         from ray_lightning_tpu import telemetry
         from ray_lightning_tpu.telemetry import exporter as _exporter
+        from ray_lightning_tpu.telemetry import tracing
         agg = telemetry.TelemetryAggregator(
             cfg.resolve_dir(trainer.default_root_dir),
             heartbeat_timeout=cfg.heartbeat_timeout,
-            hard_timeout=cfg.hard_timeout)
+            hard_timeout=cfg.hard_timeout,
+            flight_capacity=cfg.flight_capacity)
         telemetry.set_active(agg)
         telemetry.enable(rank=0, sink=lambda recs: agg.ingest_records(
             0, recs), capacity=cfg.capacity, flush_every=cfg.flush_every)
         server = None
+        profile_env_set = False
         if cfg.metrics:
             telemetry.enable_metrics(rank=0, sink=agg.ingest_metrics,
                                      interval=cfg.metrics_interval)
-            server = _exporter.start_metrics_server(agg, cfg)
+            # on-demand profiling (POST /debug/profile): the "worker" IS
+            # this process, so the control file is trivially shared —
+            # point the loop engine's poller at it for the fit's span
+            control = os.path.join(agg.out_dir, "profile",
+                                   "control.json")
+            profile_ctl = tracing.FileProfileController(control)
+            if tracing.PROFILE_CONTROL_ENV not in os.environ:
+                os.environ[tracing.PROFILE_CONTROL_ENV] = control
+                profile_env_set = True
+                tracing.reset_profile_tick()
+            server = _exporter.start_metrics_server(
+                agg, cfg, profile_controller=profile_ctl)
         try:
             return trainer._run_stage(module, datamodule, stage, ckpt_path)
         finally:
@@ -68,6 +83,9 @@ class LocalPlugin(ExecutionPlugin):
             telemetry.flush()
             telemetry.disable()
             telemetry.set_active(None)
+            if profile_env_set:
+                os.environ.pop(tracing.PROFILE_CONTROL_ENV, None)
+                tracing.reset_profile_tick()
             if server is not None:
                 server.stop()
             trainer._telemetry_paths = agg.export()

@@ -302,11 +302,24 @@ class RayXlaPlugin(ExecutionPlugin):
         self._backend = backend
         base_env = self._worker_env_base()
         cfg = trainer.telemetry
+        profile_ctl = None
         if cfg.enabled:
             # workers heartbeat from process start (worker_main) and
             # record spans once the fit payload arrives (_worker_run)
             base_env["RLT_TELEMETRY"] = "1"
             base_env["RLT_HEARTBEAT_INTERVAL"] = str(cfg.heartbeat_interval)
+            if cfg.metrics and getattr(backend, "shared_filesystem",
+                                       False):
+                # on-demand profiling for fits (POST /debug/profile):
+                # shared-FS backends get a control file the loop engine
+                # polls each dispatch; its location ships via env
+                # (telemetry/tracing.py FileProfileController)
+                from ray_lightning_tpu.telemetry import tracing
+                control = os.path.join(
+                    cfg.resolve_dir(trainer.default_root_dir),
+                    "profile", "control.json")
+                profile_ctl = tracing.FileProfileController(control)
+                base_env[tracing.PROFILE_CONTROL_ENV] = control
         # persistent-compilation-cache knobs: the pickled trainer already
         # carries the config, but the env keeps worker-side tooling that
         # consults RLT_COMPILE_CACHE* (e.g. a nested fit) consistent.
@@ -352,7 +365,8 @@ class RayXlaPlugin(ExecutionPlugin):
             agg = telemetry.TelemetryAggregator(
                 cfg.resolve_dir(trainer.default_root_dir),
                 heartbeat_timeout=cfg.heartbeat_timeout,
-                hard_timeout=cfg.hard_timeout)
+                hard_timeout=cfg.hard_timeout,
+                flight_capacity=cfg.flight_capacity)
             # elastic restart count survives the per-attempt aggregator
             # rebuild so /metrics' rlt_restarts_total is cumulative
             agg.set_restarts(getattr(self, "_elastic_restarts", 0))
@@ -363,7 +377,8 @@ class RayXlaPlugin(ExecutionPlugin):
             if cfg.metrics:
                 # live /metrics + /status on the driver: workers' metric
                 # windows arrive over the queue during _execution_loop
-                server = _exporter.start_metrics_server(agg, cfg)
+                server = _exporter.start_metrics_server(
+                    agg, cfg, profile_controller=profile_ctl)
                 self._metrics_server = server
         from ray_lightning_tpu.core import datacheck
         dc = None

@@ -29,6 +29,15 @@ Invariants (pinned by tests/test_serve.py and serve/selfcheck.py):
 slot indices are unique among live requests; per-tenant active count
 never exceeds its quota; a submitted request is eventually completed
 (no starvation) while the pump keeps stepping.
+
+Trace plane (telemetry/tracing.py): every request carries a trace id
+minted at submit; the plan broadcast propagates it to the workers
+(prefill entries ``trace=``, decode a slot→trace map), and the
+scheduler records the driver-side phases — a ``queue_wait`` span at
+admission and a ``request`` summary span at completion/failure carrying
+the latency attribution — so the aggregator reassembles one span tree
+per request.  Failed/drained requests land in the TTFT/TPOT histograms
+under ``status="failed"`` (``fail_all``), never silently unobserved.
 """
 
 from __future__ import annotations
@@ -44,6 +53,7 @@ import numpy as np
 from ray_lightning_tpu.serve.buckets import bucket_for, pad_to_bucket
 from ray_lightning_tpu.serve.kvcache import SlotAllocator
 from ray_lightning_tpu.telemetry import metrics as _metrics
+from ray_lightning_tpu.telemetry import tracing as _tracing
 
 #: histogram bounds for TTFT/TPOT (seconds): sub-ms CPU-mesh decodes up
 #: to multi-second cold paths
@@ -73,7 +83,15 @@ class ServeRequest:
         #: absolute position of the LAST generated token (the next
         #: decode step's input position)
         self.pos: Optional[int] = None
+        #: distributed trace id (telemetry/tracing.py): rides the plan
+        #: broadcast to the workers, whose prefill/decode spans carry it
+        #: back, so the aggregator reassembles this request's span tree
+        self.trace = _tracing.mint_trace_id()
         self.t_submit = time.monotonic()
+        #: wall-clock twins of the monotonic stamps — the trace plane's
+        #: synthetic driver spans must share the workers' wall timeline
+        self.t_submit_wall = time.time()
+        self.t_admit: Optional[float] = None
         self.t_first: Optional[float] = None
         self.t_done: Optional[float] = None
         self.error: Optional[BaseException] = None
@@ -106,6 +124,21 @@ class ServeRequest:
                 or len(self.generated) < 2:
             return None
         return (self.t_done - self.t_first) / (len(self.generated) - 1)
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        """Submit→admission wait — the queue's share of TTFT (the
+        per-tenant p99 the bench and /status report)."""
+        if self.t_admit is None:
+            return None
+        return self.t_admit - self.t_submit
+
+    @property
+    def decode_s(self) -> Optional[float]:
+        """First token→completion — the decode share of total latency."""
+        if self.t_done is None or self.t_first is None:
+            return None
+        return self.t_done - self.t_first
 
     # -- scheduler internal ------------------------------------------------
 
@@ -227,14 +260,27 @@ class Scheduler:
                 slot = self.allocator.acquire()
                 req.slot = slot
                 req.state = "active"
+                req.t_admit = time.monotonic()
                 tenant.active += 1
                 self._by_slot[slot] = req
                 prefills.append({
                     "req": req.id, "slot": slot, "bucket": req.bucket,
                     "tokens": pad_to_bucket(req.tokens, req.bucket),
                     "length": int(len(req.tokens)),
+                    # trace id: the driver→worker leg of the trace-
+                    # context propagation (the worker's prefill span
+                    # carries it back on the queue channel)
+                    "trace": req.trace,
                 })
                 budget -= 1
+                # the queue-wait phase of this request's span tree +
+                # its numeric twin (per-tenant labeled histogram)
+                wait = req.queue_wait_s
+                _tracing.record_request_span(
+                    "queue_wait", req.t_submit_wall, time.time(),
+                    trace=req.trace, tenant=req.tenant, req=req.id)
+                self._observe("rlt_serve_queue_wait_seconds", wait,
+                              tenant=req.tenant)
             # decode advances every slot that already HAS a first token
             # (slots prefilled this very step join the next decode)
             decode_slots = sorted(
@@ -249,7 +295,12 @@ class Scheduler:
                 tokens[s] = r.generated[-1]
                 positions[s] = r.pos
             decode = {"tokens": tokens, "positions": positions,
-                      "slots": decode_slots}
+                      "slots": decode_slots,
+                      # slot→trace map: ONE decode program advances many
+                      # requests, so its worker span fans out to every
+                      # live request's tree (aggregator._span_trace_ids)
+                      "traces": {s: self._by_slot[s].trace
+                                 for s in decode_slots}}
         if not prefills and decode is None:
             return None
         if decode is not None:
@@ -275,7 +326,8 @@ class Scheduler:
             req.t_first = now
             req.generated.append(tok)
             req.pos = len(req.tokens)       # the first token's position
-            self._observe("rlt_serve_ttft_seconds", req.ttft_s)
+            self._observe("rlt_serve_ttft_seconds", req.ttft_s,
+                          status="ok")
             self._count("rlt_serve_tokens_total", 1, tenant=req.tenant)
             self._tenant(req.tenant).served_tokens += 1
             self._maybe_finish(req, tok)
@@ -303,12 +355,35 @@ class Scheduler:
             self._tenant(req.tenant).active -= 1
             self.completed += 1
         req._finish()     # stamps t_done — tpot_s is defined only after
-        self._observe("rlt_serve_tpot_seconds", req.tpot_s)
-        self._count("rlt_serve_requests_total", 1, tenant=req.tenant)
+        self._observe("rlt_serve_tpot_seconds", req.tpot_s, status="ok")
+        self._count("rlt_serve_requests_total", 1, tenant=req.tenant,
+                    status="ok")
+        self._request_span(req, "ok")
+
+    def _request_span(self, req: ServeRequest, status: str) -> None:
+        """The request's driver-side summary span: whole submit→done
+        life on the wall timeline, carrying the latency attribution the
+        aggregator's tenant_breakdown reads (queue_s/ttft_s/tpot_s)."""
+        _tracing.record_request_span(
+            "request", req.t_submit_wall, time.time(),
+            trace=req.trace, tenant=req.tenant, req=req.id,
+            status=status, tokens=len(req.generated),
+            queue_s=req.queue_wait_s, ttft_s=req.ttft_s,
+            tpot_s=req.tpot_s)
 
     def fail_all(self, error: BaseException) -> None:
         """Propagate a fleet failure into every live/queued request so
-        no caller blocks forever on ``result()``."""
+        no caller blocks forever on ``result()``.
+
+        Latency accounting (trace-plane satellite): failed and drained
+        requests used to vanish from the TTFT/TPOT histograms entirely,
+        biasing them optimistic — a fleet that fell over under load
+        reported only the requests that finished before it did.  Every
+        request failed here now lands in the histograms under a
+        ``status="failed"`` label: time-to-failure for requests that
+        never produced a token, the partial decode rate for those that
+        did."""
+        now = time.monotonic()
         with self._lock:
             live = list(self._by_slot.values())
             queued = [r for t in self._tenants.values() for r in t.queue]
@@ -320,6 +395,21 @@ class Scheduler:
             self.failed += len(live) + len(queued)
         for r in live + queued:
             r._finish(error)
+            # TTFT for a request that never got a first token = its
+            # time-to-failure; a partially-decoded one keeps its real
+            # TTFT and gets a failure-truncated TPOT
+            ttft = r.ttft_s if r.t_first is not None \
+                else now - r.t_submit
+            self._observe("rlt_serve_ttft_seconds", ttft,
+                          status="failed")
+            if r.t_first is not None and len(r.generated) >= 2:
+                self._observe(
+                    "rlt_serve_tpot_seconds",
+                    (r.t_done - r.t_first) / (len(r.generated) - 1),
+                    status="failed")
+            self._count("rlt_serve_requests_total", 1, tenant=r.tenant,
+                        status="failed")
+            self._request_span(r, "failed")
 
     # -- stats -------------------------------------------------------------
 
@@ -355,10 +445,12 @@ class Scheduler:
             reg.gauge(name).set(value)
 
     @staticmethod
-    def _observe(name: str, value: Optional[float]) -> None:
+    def _observe(name: str, value: Optional[float],
+                 **labels: Any) -> None:
         reg = _metrics.get_registry()
         if reg is not None and value is not None:
-            reg.histogram(name, buckets=LATENCY_BUCKETS).observe(value)
+            reg.histogram(name, buckets=LATENCY_BUCKETS).observe(
+                value, **labels)
 
 
 __all__ = ["Scheduler", "ServeRequest", "LATENCY_BUCKETS"]

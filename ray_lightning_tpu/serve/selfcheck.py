@@ -107,6 +107,7 @@ def _check_metric_names() -> None:
                  "rlt_serve_queue_depth_total",
                  "rlt_serve_active_slots_total",
                  "rlt_serve_ttft_seconds", "rlt_serve_tpot_seconds",
+                 "rlt_serve_queue_wait_seconds",
                  "rlt_serve_traces_total",
                  "rlt_serve_prefill_seconds_total",
                  "rlt_serve_decode_seconds_total"):

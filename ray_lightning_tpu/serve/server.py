@@ -126,6 +126,7 @@ class Server:
         self._queue = None
         self._agg = None
         self._metrics_server = None
+        self._profile_ctl = None
         self._pump: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._work = threading.Event()
@@ -255,7 +256,8 @@ class Server:
         agg = telemetry.TelemetryAggregator(
             cfg.resolve_dir(self.default_root_dir),
             heartbeat_timeout=cfg.heartbeat_timeout,
-            hard_timeout=cfg.hard_timeout)
+            hard_timeout=cfg.hard_timeout,
+            flight_capacity=cfg.flight_capacity)
         for i, w in enumerate(self._workers):
             agg.register_worker(i, w)
         telemetry.set_active(agg)
@@ -267,12 +269,25 @@ class Server:
             # as the workers' windows
             telemetry.enable_metrics(rank=-1, sink=agg.ingest_metrics,
                                      interval=cfg.metrics_interval)
-            self._metrics_server = _exporter.start_metrics_server(agg, cfg)
+            # POST /debug/profile?steps=N: the pump attaches the armed
+            # window to the next plan broadcast (tracing.py)
+            from ray_lightning_tpu.telemetry.tracing import (
+                ServeProfileController)
+            self._profile_ctl = ServeProfileController(agg.out_dir)
+            self._metrics_server = _exporter.start_metrics_server(
+                agg, cfg, profile_controller=self._profile_ctl)
 
     @property
     def metrics_url(self) -> Optional[str]:
         return self._metrics_server.url \
             if self._metrics_server is not None else None
+
+    def profile_status(self) -> Optional[dict]:
+        """State of the on-demand jax.profiler window (same document
+        ``/status`` serves under ``profile``); None when telemetry
+        metrics are off."""
+        return self._profile_ctl.status() \
+            if self._profile_ctl is not None else None
 
     # -- request surface ---------------------------------------------------
 
@@ -320,6 +335,13 @@ class Server:
                 self._work.wait(0.02)
                 self._work.clear()
                 continue
+            if self._profile_ctl is not None:
+                # armed profile window rides the SAME broadcast as the
+                # trace ids — every worker starts its capture on this
+                # plan and the driver counts the window's steps
+                pending = self._profile_ctl.take_pending()
+                if pending is not None:
+                    plan["profile"] = pending
             try:
                 futures = [w.call("serve_step", plan)
                            for w in self._workers]
@@ -342,6 +364,8 @@ class Server:
                 sched.fail_all(e)
                 return
             sched.apply(plan, result)
+            if self._profile_ctl is not None:
+                self._profile_ctl.note_step()
 
     def _drain_queue(self) -> None:
         backend = self._backend
@@ -430,6 +454,7 @@ class Server:
                     self._metrics_server.url
             self._agg = None
             self._metrics_server = None
+            self._profile_ctl = None
         self._started = False
 
     def _kill_workers(self) -> None:

@@ -13,6 +13,14 @@ Lockstep contract: every worker of a fleet receives the IDENTICAL plan
 and dispatches the same SPMD programs in the same order; rank 0 alone
 returns the produced tokens (outputs are replicated, the others return
 ``None`` to keep the RPC thin).
+
+Trace plane (telemetry/tracing.py): the plan carries each request's
+trace id (prefill entries) and a slot→trace map (decode), so this
+worker's prefill/decode spans carry the ids back over the queue channel
+and the driver aggregator reassembles one span tree per request.  The
+plan may also carry a ``profile`` control dict — the on-demand
+``jax.profiler`` window armed by ``POST /debug/profile``; every rank
+captures its own subdir for the window's step count.
 """
 
 from __future__ import annotations
@@ -22,6 +30,8 @@ import os
 from typing import Any, Optional
 
 from ray_lightning_tpu.cluster.executor import RLTExecutor
+from ray_lightning_tpu.telemetry import span
+from ray_lightning_tpu.telemetry.tracing import WorkerProfiler
 
 _log = logging.getLogger(__name__)
 
@@ -36,6 +46,7 @@ class ServeWorker(RLTExecutor):
         self._nproc = 1
         self._hb = None
         self._telemetry_cfg = None
+        self._profiler: Optional[WorkerProfiler] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -115,15 +126,31 @@ class ServeWorker(RLTExecutor):
         engine = self._engine
         if engine is None:
             raise RuntimeError("serve_step before setup_serve")
+        prof = plan.get("profile")
+        if prof is not None:
+            # on-demand jax.profiler window riding the plan broadcast
+            # (POST /debug/profile, telemetry/tracing.py)
+            if self._profiler is None:
+                self._profiler = WorkerProfiler(rank=self._rank)
+            self._profiler.maybe_start(prof)
         result: dict[str, Any] = {"prefill": {}, "decode": {}}
         decode = plan.get("decode")
         if decode is not None:
-            toks = engine.decode(decode["tokens"], decode["positions"])
+            # ONE span for the shared decode program, fanned out to
+            # every live request's tree via the slot→trace map
+            with span("decode", traces=decode.get("traces"),
+                      slots=len(decode["slots"])):
+                toks = engine.decode(decode["tokens"],
+                                     decode["positions"])
             for s in decode["slots"]:
                 result["decode"][s] = int(toks[s])
         for p in plan["prefills"]:
-            result["prefill"][p["slot"]] = engine.prefill(
-                p["slot"], p["tokens"], p["length"], p["bucket"])
+            with span("prefill", trace=p.get("trace"),
+                      bucket=p["bucket"], slot=p["slot"]):
+                result["prefill"][p["slot"]] = engine.prefill(
+                    p["slot"], p["tokens"], p["length"], p["bucket"])
+        if self._profiler is not None:
+            self._profiler.note_step()
         return result if self._rank == 0 else None
 
     # -- evidence / teardown -----------------------------------------------
@@ -135,6 +162,8 @@ class ServeWorker(RLTExecutor):
         """Graceful worker exit: flush telemetry, leave the coordination
         service cleanly (the fit path's teardown discipline,
         plugins/xla.py)."""
+        if self._profiler is not None:
+            self._profiler.stop()   # close a window the drain truncated
         cfg = self._telemetry_cfg
         if cfg is not None and cfg.enabled:
             from ray_lightning_tpu import telemetry

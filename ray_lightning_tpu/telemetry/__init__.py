@@ -1,5 +1,5 @@
 """Distributed run telemetry: per-worker spans, driver aggregation,
-heartbeats and Perfetto trace export.
+heartbeats, Perfetto trace export — and the trace plane on top.
 
 One coherent observability layer replacing three disconnected ones
 (rank-0-only ThroughputMonitor numbers, the CSVLogger, and external
@@ -9,7 +9,10 @@ and the driver merges them into a Chrome/Perfetto ``trace.json`` +
 ``telemetry.jsonl`` with per-rank step percentiles and straggler skew
 (``aggregator.py``).  Worker heartbeats (``heartbeat.py``) feed a
 driver watchdog that names a dead or wedged rank instead of hanging
-silently.
+silently.  ``tracing.py`` ties spans to *requests* (per-request trace
+ids through the serve plan broadcast, per-tenant latency attribution)
+and arms on-demand ``jax.profiler`` windows; ``flight.py`` is the
+crash black box dumped at death-classification time.
 
 Enable with ``Trainer(telemetry=True)`` (or a config dict /
 ``TelemetryConfig``), or process-wide with ``RLT_TELEMETRY=1``.
@@ -43,6 +46,15 @@ from ray_lightning_tpu.telemetry.aggregator import (  # noqa: F401
     set_active,
     spans_item,
 )
+from ray_lightning_tpu.telemetry.flight import (  # noqa: F401
+    FlightRecorder,
+    flight_path,
+)
+from ray_lightning_tpu.telemetry.tracing import (  # noqa: F401
+    mint_trace_id,
+    profile_tick,
+    record_request_span,
+)
 from ray_lightning_tpu.telemetry.metrics import (  # noqa: F401
     MetricsRegistry,
     disable_metrics,
@@ -75,6 +87,11 @@ __all__ = [
     "get_active",
     "set_active",
     "spans_item",
+    "FlightRecorder",
+    "flight_path",
+    "mint_trace_id",
+    "record_request_span",
+    "profile_tick",
     "MetricsRegistry",
     "enable_metrics",
     "disable_metrics",
@@ -105,6 +122,11 @@ class TelemetryConfig:
     hard_timeout: Optional[float] = None
     flush_every: int = 256
     capacity: int = 65536
+    #: crash flight recorder (telemetry/flight.py): per-rank ring of the
+    #: most recent driver-ingested records, dumped as flight_<rank>.json
+    #: on a wedge verdict / death classification.  Bounded by this many
+    #: records per rank; 0 still keeps heartbeats (min ring is 1).
+    flight_capacity: int = 256
     #: metrics plane (telemetry/metrics.py): per-rank typed instruments
     #: (HBM gauges, step-time histogram, collective byte counters)
     #: riding the same worker→driver channel as spans

@@ -20,7 +20,17 @@ aggregator:
   longer than ``heartbeat_timeout`` gets a driver log line naming the
   rank, its last span and heartbeat age — the "which worker wedged"
   diagnosis the reference never had (a straggling host was invisible
-  until the whole job stalled, SURVEY.md §5).
+  until the whole job stalled, SURVEY.md §5);
+- mirrors every ingested batch into the crash flight recorder
+  (telemetry/flight.py): bounded per-rank rings dumped as
+  ``flight_<rank>.json`` on a wedge verdict, at elastic
+  death-classification time, or when the failure diagnosis finds a
+  dead process — the black box the normal export path cannot be;
+- reassembles per-request span trees from the trace ids the serve
+  plane's plan broadcast propagates (telemetry/tracing.py):
+  ``request_trees`` groups driver + worker spans by trace id, and
+  ``tenant_breakdown`` summarizes per-tenant TTFT/TPOT with queue vs
+  prefill vs decode attribution for ``/status``.
 
 The active aggregator is THREAD-local (``set_active``): the builtin
 tune runner executes trials on threads, and each trial's
@@ -80,11 +90,16 @@ class TelemetryAggregator:
 
     def __init__(self, out_dir: str, heartbeat_timeout: float = 60.0,
                  hard_timeout: Optional[float] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, flight_capacity: int = 256):
+        from ray_lightning_tpu.telemetry.flight import FlightRecorder
         self.out_dir = out_dir
         self.heartbeat_timeout = heartbeat_timeout
         self.hard_timeout = hard_timeout
         self._clock = clock
+        #: crash black box: bounded per-rank rings of the most recent
+        #: ingested spans/heartbeats, dumpable independently of export
+        self.flight = FlightRecorder(out_dir,
+                                     span_capacity=flight_capacity)
         self._lock = threading.Lock()
         self._records: list[dict] = []
         #: pid -> {"at": driver clock, "beat": latest beat dict}; keyed
@@ -212,6 +227,7 @@ class TelemetryAggregator:
             r.setdefault("rank", rank)
         with self._lock:
             self._records.extend(records)
+        self.flight.note_records(rank, records)
 
     def _note_heartbeat(self, beat: dict) -> None:
         key = beat.get("pid") or beat.get("rank", -1)
@@ -219,6 +235,9 @@ class TelemetryAggregator:
             self._hb[key] = {"at": self._clock(), "beat": beat}
             # a recovered worker (e.g. un-wedged) re-arms its warning
             self._warned.discard(key)
+        self.flight.note_heartbeat(beat)
+        self.flight.note_metrics_brief(beat.get("rank", -1),
+                                       beat.get("metrics"))
 
     def heartbeats(self) -> dict:
         """Latest beat per worker process, with its current age on the
@@ -305,6 +324,16 @@ class TelemetryAggregator:
                     self._describe(beat, age),
                     self._alive_note(beat.get("rank", -1)),
                     self.heartbeat_timeout)
+                # wedge verdict: dump the rank's black box NOW — a
+                # wedged worker will never flush again, so the ring is
+                # the only record of what it was doing
+                rank = beat.get("rank", -1)
+                self.flight.dump(
+                    rank,
+                    f"watchdog wedge verdict: heartbeat silent "
+                    f"{age:.1f}s (timeout {self.heartbeat_timeout:.1f}s)"
+                    f"{self._alive_note(rank)}",
+                    handle=self._workers.get(rank))
             if self.hard_timeout is not None and age > self.hard_timeout:
                 raise WorkerHeartbeatTimeout(
                     f"telemetry watchdog: {self._describe(beat, age)} "
@@ -324,6 +353,26 @@ class TelemetryAggregator:
         lines = [self._describe(beat, now - at) for at, beat in snapshot]
         _log.warning("telemetry: worker state at failure:\n  %s",
                      "\n  ".join(lines))
+        # black-box dumps for every rank whose process probe reads dead:
+        # the failure that just surfaced on the driver is about to tear
+        # the fleet down, and these rings are the last evidence
+        for rank, handle in sorted(self._workers.items()):
+            alive = getattr(handle, "process_alive", lambda: None)() \
+                if handle is not None else None
+            if alive is False:
+                self.flight.dump(rank, "worker failure: process dead "
+                                 "at failure diagnosis", handle=handle)
+
+    def dump_flights(self, ranks, cause: str) -> list:
+        """Dump ``flight_<rank>.json`` for each given rank (the elastic
+        driver's death-classification hook).  Returns the paths."""
+        out = []
+        for rank in ranks:
+            path = self.flight.dump(rank, cause,
+                                    handle=self._workers.get(rank))
+            if path:
+                out.append(path)
+        return out
 
     # -- analysis --------------------------------------------------------
 
@@ -356,6 +405,103 @@ class TelemetryAggregator:
             # straggler skew: how much slower the slowest rank's mean
             # step is than the fastest rank's (1.0 = perfectly even)
             out["straggler_skew"] = round(max(means) / min(means), 3)
+        return out
+
+    # -- per-request tracing (telemetry/tracing.py) ----------------------
+
+    @staticmethod
+    def _span_trace_ids(record: dict) -> list:
+        """Trace ids a span belongs to: its own ``trace`` attr plus
+        every id in a shared span's ``traces`` map (the serve decode
+        advances many requests in one program — the span fans out to
+        each of their trees)."""
+        attrs = record.get("attrs") or {}
+        ids = []
+        tid = attrs.get("trace")
+        if tid:
+            ids.append(str(tid))
+        shared = attrs.get("traces")
+        if isinstance(shared, dict):
+            ids.extend(str(t) for t in shared.values() if t)
+        elif isinstance(shared, (list, tuple)):
+            ids.extend(str(t) for t in shared if t)
+        return ids
+
+    def request_trees(self) -> dict[str, list[dict]]:
+        """trace id -> that request's spans (driver + every rank),
+        time-ordered: the reassembled queue→prefill→decode→complete
+        tree of each request's life."""
+        with self._lock:
+            records = list(self._records)
+        trees: dict[str, list[dict]] = {}
+        for r in records:
+            if r.get("t") != "span":
+                continue
+            for tid in self._span_trace_ids(r):
+                trees.setdefault(tid, []).append(r)
+        for spans_ in trees.values():
+            spans_.sort(key=lambda r: (r.get("ts", 0.0),
+                                       r.get("depth", 0)))
+        return trees
+
+    def tenant_breakdown(self) -> dict[str, dict]:
+        """Per-tenant request-latency attribution from the driver-side
+        ``request`` summary spans (+ worker ``prefill`` spans joined by
+        trace id): TTFT split into queue wait vs prefill, decode time
+        and TPOT — the "which phase is slow for WHICH tenant" surface
+        on ``/status`` and in the exported summary."""
+        with self._lock:
+            records = list(self._records)
+        prefill_by_trace: dict[str, float] = {}
+        requests: list[tuple[dict, dict]] = []
+        for r in records:
+            if r.get("t") != "span":
+                continue
+            attrs = r.get("attrs") or {}
+            if r.get("name") == "prefill" and attrs.get("trace") \
+                    and r.get("rank", -1) >= 0:
+                prefill_by_trace[str(attrs["trace"])] = float(
+                    r.get("dur", 0.0))
+            elif r.get("name") == "request":
+                requests.append((r, attrs))
+        out: dict[str, dict] = {}
+        acc: dict[str, dict[str, list]] = {}
+        for r, attrs in requests:
+            tenant = str(attrs.get("tenant", "default"))
+            entry = out.setdefault(tenant, {"requests": 0, "failed": 0,
+                                            "tokens": 0})
+            a = acc.setdefault(tenant, {"queue_wait": [], "ttft": [],
+                                        "prefill": [], "decode": [],
+                                        "tpot": []})
+            entry["requests"] += 1
+            entry["tokens"] += int(attrs.get("tokens", 0) or 0)
+            if attrs.get("status") == "failed":
+                entry["failed"] += 1
+            ttft = attrs.get("ttft_s")
+            queue = attrs.get("queue_s")
+            tpot = attrs.get("tpot_s")
+            if queue is not None:
+                a["queue_wait"].append(float(queue))
+            if ttft is not None:
+                a["ttft"].append(float(ttft))
+                # decode attribution: everything after the first token
+                a["decode"].append(
+                    max(0.0, float(r.get("dur", 0.0)) - float(ttft)))
+            if tpot is not None:
+                a["tpot"].append(float(tpot))
+            pf = prefill_by_trace.get(str(attrs.get("trace")))
+            if pf is not None:
+                a["prefill"].append(pf)
+        for tenant, phases in acc.items():
+            entry = out[tenant]
+            for phase, vals in phases.items():
+                vals.sort()
+                if not vals:
+                    continue
+                entry[f"{phase}_p50_ms"] = round(
+                    _percentile(vals, 50) * 1e3, 3)
+                entry[f"{phase}_p99_ms"] = round(
+                    _percentile(vals, 99) * 1e3, 3)
         return out
 
     # -- metrics derivations ---------------------------------------------
@@ -482,6 +628,17 @@ class TelemetryAggregator:
             "ranks": sorted({r.get("rank", -1) for r in records}),
             "step_stats": stats,
         }
+        trees = self.request_trees()
+        if trees:
+            # per-request trace plane: every traced request's span count
+            # (the full trees are in trace.json via their trace attrs)
+            # plus the per-tenant latency attribution
+            summary["requests"] = {
+                "traced": len(trees),
+                "tenants": self.tenant_breakdown(),
+            }
+        if self.flight.dumped:
+            summary["flight_dumps"] = dict(self.flight.dumped)
         collectives = self.collective_stats()
         hbm = self.hbm_stats()
         dropped = self.dropped_stats()

@@ -12,7 +12,15 @@ scrapable while the run is live:
   driver serving ``GET /metrics`` (exposition) and ``GET /status``
   (JSON: per-rank heartbeat age, current step, step p50/p95, HBM, last
   collective — the "is it healthy right now" complement to the
-  post-hoc Perfetto trace).
+  post-hoc Perfetto trace).  With the trace plane live, ``/status``
+  additionally carries per-tenant TTFT/TPOT breakdowns (queue vs
+  prefill vs decode attribution), the flight-recorder dump paths, and
+  the profile-window state.
+- ``POST /debug/profile?steps=N`` — on-demand ``jax.profiler`` capture
+  (telemetry/tracing.py controllers): the serve plane arms a window on
+  the next plan broadcast; the fit plane writes the control file its
+  workers poll.  The resulting trace dir is linked from ``/status`` —
+  no "restart with the callback configured".
 
 No third-party client library: the exposition format is a few lines of
 text, and the driver must stay dependency-free (ROADMAP constraint).
@@ -75,9 +83,11 @@ def render_prometheus(aggregator) -> str:
     return "\n".join(lines) + "\n"
 
 
-def render_status(aggregator) -> dict:
+def render_status(aggregator, profile_controller=None) -> dict:
     """JSON status document: one entry per rank with liveness +
-    progress + step latency percentiles."""
+    progress + step latency percentiles, plus the trace plane's
+    per-tenant latency breakdown, flight-recorder dumps and the
+    on-demand profile-window state."""
     stats = aggregator.step_stats().get("per_rank", {})
     briefs = aggregator.metrics_briefs()
     ranks: dict[str, dict] = {}
@@ -97,7 +107,19 @@ def render_status(aggregator) -> dict:
         entry["step_p50_ms"] = st.get("p50_ms")
         entry["step_p95_ms"] = st.get("p95_ms")
         entry["steps_recorded"] = st.get("steps")
-    return {"ranks": ranks}
+    doc: dict = {"ranks": ranks}
+    tenants = aggregator.tenant_breakdown()
+    if tenants:
+        # per-request trace plane: TTFT/TPOT with queue vs prefill vs
+        # decode attribution, per tenant (aggregator.tenant_breakdown)
+        doc["tenants"] = tenants
+        doc["traced_requests"] = len(aggregator.request_trees())
+    if aggregator.flight.dumped:
+        doc["flight_dumps"] = {str(r): p for r, p
+                               in aggregator.flight.dumped.items()}
+    if profile_controller is not None:
+        doc["profile"] = profile_controller.status()
+    return doc
 
 
 class MetricsHTTPServer:
@@ -107,8 +129,9 @@ class MetricsHTTPServer:
     trials never collide."""
 
     def __init__(self, aggregator, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", profile_controller=None):
         agg = aggregator
+        profiler = profile_controller
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):          # noqa: N802 - stdlib API name
@@ -117,7 +140,8 @@ class MetricsHTTPServer:
                         body = render_prometheus(agg).encode()
                         ctype = "text/plain; version=0.0.4; charset=utf-8"
                     elif self.path.split("?")[0] == "/status":
-                        body = json.dumps(render_status(agg)).encode()
+                        body = json.dumps(
+                            render_status(agg, profiler)).encode()
                         ctype = "application/json"
                     else:
                         self.send_error(404)
@@ -128,6 +152,34 @@ class MetricsHTTPServer:
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):         # noqa: N802 - stdlib API name
+                path, _, query = self.path.partition("?")
+                if path != "/debug/profile":
+                    self.send_error(404)
+                    return
+                if profiler is None:
+                    self.send_error(
+                        501, "no profile controller on this run "
+                        "(serve fleet / shared-filesystem fit only)")
+                    return
+                try:
+                    from urllib.parse import parse_qs
+                    steps = int(parse_qs(query).get("steps", ["8"])[0])
+                    resp = profiler.request(steps)
+                except (ValueError, OSError) as e:
+                    self.send_error(400, str(e))
+                    return
+                except Exception:   # arming must never crash the run
+                    _log.warning("profile arm failed", exc_info=True)
+                    self.send_error(500)
+                    return
+                body = json.dumps(resp).encode()
+                self.send_response(200 if resp.get("accepted") else 409)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -161,7 +213,9 @@ class MetricsHTTPServer:
             pass
 
 
-def start_metrics_server(aggregator, cfg) -> Optional[MetricsHTTPServer]:
+def start_metrics_server(aggregator, cfg,
+                         profile_controller=None
+                         ) -> Optional[MetricsHTTPServer]:
     """Start the driver endpoint when the config asks for one.
 
     Port resolution: ``TelemetryConfig.metrics_port`` or the
@@ -183,7 +237,9 @@ def start_metrics_server(aggregator, cfg) -> Optional[MetricsHTTPServer]:
                   "an ephemeral port instead of %d", port)
         port = 0
     try:
-        server = MetricsHTTPServer(aggregator, port=port).start()
+        server = MetricsHTTPServer(
+            aggregator, port=port,
+            profile_controller=profile_controller).start()
     except OSError as e:
         _log.warning("metrics exporter: could not bind port %s (%s); "
                      "run continues without a live endpoint", port, e)

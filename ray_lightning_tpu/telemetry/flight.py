@@ -1,0 +1,151 @@
+"""Crash flight recorder: bounded per-rank rings of recent telemetry,
+dumped at death-classification time.
+
+The normal telemetry path buffers everything in the aggregator and
+exports once at teardown — which is exactly when a postmortem needs it
+least: a rank that dies mid-run leaves its most recent spans either
+un-flushed in the dead process or buried in a trace.json nobody
+correlates with the failure.  The flight recorder is the black box:
+
+- every span/counter batch, heartbeat and metrics brief the aggregator
+  ingests is mirrored into a per-rank ring (``collections.deque`` with
+  ``maxlen`` — the bounded-size invariant is structural, not policed);
+- the rings survive OUTSIDE the flush/export path: dumping does not
+  consume them, and they cost O(capacity) memory per rank regardless of
+  run length;
+- :meth:`FlightRecorder.dump` writes ``flight_<rank>.json`` — the
+  rank's last spans/counters, heartbeat trail, latest metrics brief,
+  the classified cause, and (when the backend can supply one) the
+  worker's log tail — so a postmortem starts from evidence instead of a
+  silent gap.
+
+Dump sites: the elastic driver at death-classification time
+(elastic/driver.py), the watchdog on a wedge verdict, and the generic
+failure diagnosis for ranks whose process probe reads dead
+(aggregator.log_failure_diagnosis).  Repeated dumps for the same rank
+overwrite — last verdict wins, which is the one correlated with the
+classified cause.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from collections import deque
+from typing import Any, Optional
+
+_log = logging.getLogger(__name__)
+
+#: default per-rank ring capacities (records, not bytes: span records
+#: are small dicts, so 256 spans ≈ tens of KB per rank)
+DEFAULT_SPANS = 256
+DEFAULT_BEATS = 32
+
+
+def flight_path(out_dir: str, rank: int) -> str:
+    return os.path.join(out_dir, f"flight_{rank}.json")
+
+
+class FlightRecorder:
+    """Per-rank bounded rings + the ``flight_<rank>.json`` dumper."""
+
+    def __init__(self, out_dir: str, span_capacity: int = DEFAULT_SPANS,
+                 beat_capacity: int = DEFAULT_BEATS):
+        self.out_dir = out_dir
+        self.span_capacity = max(1, int(span_capacity))
+        self.beat_capacity = max(1, int(beat_capacity))
+        self._records: dict[int, deque] = {}
+        self._beats: dict[int, deque] = {}
+        self._briefs: dict[int, dict] = {}
+        #: rank -> path of the last dump (status/test surface)
+        self.dumped: dict[int, str] = {}
+
+    # -- ingestion mirrors (called under the aggregator's lock-free
+    # ingest paths; deque appends are atomic) ---------------------------
+
+    def note_records(self, rank: int, records: list) -> None:
+        ring = self._records.get(rank)
+        if ring is None:
+            ring = self._records[rank] = deque(maxlen=self.span_capacity)
+        ring.extend(records)
+
+    def note_heartbeat(self, beat: dict) -> None:
+        rank = beat.get("rank", -1)
+        ring = self._beats.get(rank)
+        if ring is None:
+            ring = self._beats[rank] = deque(maxlen=self.beat_capacity)
+        ring.append({k: beat.get(k) for k in
+                     ("rank", "pid", "host", "wall", "last_span",
+                      "metrics", "dropped")})
+
+    def note_metrics_brief(self, rank: int, brief: Optional[dict]) -> None:
+        if brief:
+            self._briefs[rank] = dict(brief)
+
+    # -- evidence surface ------------------------------------------------
+
+    def last_spans(self, rank: int) -> list[dict]:
+        return [r for r in self._records.get(rank, ())
+                if r.get("t") == "span"]
+
+    def dump(self, rank: int, cause: str,
+             handle: Any = None) -> Optional[str]:
+        """Write ``flight_<rank>.json`` under ``out_dir``; returns the
+        path (None only when the write itself fails — a flight dump
+        must never raise into failure handling)."""
+        records = list(self._records.get(rank, ()))
+        beats = list(self._beats.get(rank, ()))
+        doc = {
+            "t": "flight",
+            "rank": rank,
+            "cause": cause,
+            "dumped_at": time.time(),
+            "records": records,
+            "spans": [r for r in records if r.get("t") == "span"],
+            "last_span": next(
+                (r["name"] for r in reversed(records)
+                 if r.get("t") == "span"), None),
+            "heartbeats": beats,
+            "last_heartbeat_wall": beats[-1]["wall"] if beats else None,
+            "metrics_brief": self._briefs.get(rank),
+            "capacity": {"spans": self.span_capacity,
+                         "heartbeats": self.beat_capacity},
+        }
+        tail = None
+        if handle is not None:
+            # backend-supplied forensic context (cluster/backend.py
+            # ActorHandle.log_tail): the built-in backend captures each
+            # worker's stdout/stderr, so the flight file carries the
+            # crash's own log lines next to its spans
+            try:
+                tail = handle.log_tail()
+            except Exception:
+                tail = None
+        if tail:
+            doc["log_tail"] = tail
+        try:
+            os.makedirs(self.out_dir, exist_ok=True)
+            path = flight_path(self.out_dir, rank)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, default=str)
+            os.replace(tmp, path)
+        except OSError:
+            _log.warning("flight recorder: dump for rank %d failed",
+                         rank, exc_info=True)
+            return None
+        self.dumped[rank] = path
+        _log.warning(
+            "flight recorder: rank %d black box -> %s (%d spans, "
+            "%d heartbeats; cause: %s)", rank, path,
+            len(doc["spans"]), len(beats), cause.splitlines()[0][:200])
+        return path
+
+    def ranks(self) -> list[int]:
+        return sorted(set(self._records) | set(self._beats))
+
+
+__all__ = ["FlightRecorder", "flight_path", "DEFAULT_SPANS",
+           "DEFAULT_BEATS"]

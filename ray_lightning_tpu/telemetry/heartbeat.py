@@ -12,9 +12,17 @@ Two start sites share this one sender:
   process-level sender (real Ray actors), after the queue proxy exists.
 
 Each beat carries rank (re-read from the environment every beat — the
-built-in backend assigns ranks after spawn), pid, host, actor id and
-the most recently entered span, so the watchdog can report "rank 2,
-last span 'step', heartbeat 34s old" instead of a silent hang.
+built-in backend assigns ranks after spawn), pid, host, actor id, the
+most recently entered span and the span ring's drop count, so the
+watchdog can report "rank 2, last span 'step', heartbeat 34s old"
+instead of a silent hang.
+
+Beats also FLUSH the span recorder first: span batches otherwise wait
+for ``flush_every`` records, and a rank that dies mid-batch takes its
+most recent spans with it — the exact evidence the driver's crash
+flight recorder (telemetry/flight.py) exists to keep.  Flushing at
+heartbeat cadence bounds that loss window to ``heartbeat_interval``
+seconds instead of up to ``flush_every`` records.
 """
 
 from __future__ import annotations
@@ -41,6 +49,7 @@ def make_heartbeat(rank: int, actor_id: Optional[str] = None) -> dict:
         "actor_id": actor_id,
         "wall": time.time(),
         "last_span": spans.last_span(),
+        "dropped": spans.dropped(),
     }
     # latest metrics brief (step, HBM, last collective) so a wedged
     # rank's watchdog diagnosis says WHAT it was doing when it went
@@ -86,6 +95,10 @@ class HeartbeatSender:
         while not self._stop.is_set():
             rank = self._rank if self._rank is not None else _env_rank()
             try:
+                # span batches first: bound the crash-loss window to one
+                # heartbeat interval (module docstring).  The recorder's
+                # sink is the same thread-safe queue this beat rides.
+                spans.flush()
                 self._send(make_heartbeat(rank, self._actor_id))
             except Exception:
                 return

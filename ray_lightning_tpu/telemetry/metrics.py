@@ -80,6 +80,11 @@ CORE_METRICS = (
     "rlt_collective_seconds_total",
     "rlt_data_wait_seconds_total",
     "rlt_telemetry_dropped_total",
+    # trace plane (telemetry/tracing.py + serve per-request tracing):
+    # alertable span-ring data loss + request-phase latency instruments
+    "rlt_spans_dropped_total",
+    "rlt_serve_queue_wait_seconds",
+    "rlt_profile_windows_total",
     # elastic plane (elastic/snapshot.py + the driver-side fleet
     # health series the aggregator synthesizes)
     "rlt_snapshot_total",
@@ -155,21 +160,23 @@ class Gauge(Counter):
 
 class Histogram:
     """Fixed-bucket cumulative histogram (Prometheus semantics: each
-    bucket counts observations <= its upper bound)."""
+    bucket counts observations <= its upper bound).  One independent
+    bucket array per label set — the serve plane's TTFT/TPOT series
+    split by ``status=ok|failed`` so failed requests stop reading as
+    missing observations (trace-plane satellite)."""
 
-    __slots__ = ("name", "buckets", "_counts", "_sum", "_count", "_lock")
+    __slots__ = ("name", "buckets", "_series", "_lock")
 
     kind = "histogram"
 
     def __init__(self, name: str, buckets: tuple = STEP_TIME_BUCKETS):
         self.name = validate_metric_name(name)
         self.buckets = tuple(sorted(float(b) for b in buckets))
-        self._counts = [0] * (len(self.buckets) + 1)   # +1: +Inf
-        self._sum = 0.0
-        self._count = 0
+        #: label key -> [counts, sum, count]
+        self._series: dict[tuple, list] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, **labels: Any) -> None:
         value = float(value)
         i = 0
         for i, b in enumerate(self.buckets):
@@ -177,17 +184,24 @@ class Histogram:
                 break
         else:
             i = len(self.buckets)
+        key = _label_key(labels)
         with self._lock:
-            self._counts[i] += 1
-            self._sum += value
-            self._count += 1
+            series = self._series.get(key)
+            if series is None:
+                series = self._series[key] = [
+                    [0] * (len(self.buckets) + 1), 0.0, 0]   # +1: +Inf
+            series[0][i] += 1
+            series[1] += value
+            series[2] += 1
 
     def snapshot(self) -> list[dict]:
         with self._lock:
-            return [{"name": self.name, "type": self.kind, "labels": {},
-                     "buckets": list(self.buckets),
-                     "counts": list(self._counts),
-                     "sum": self._sum, "count": self._count}]
+            items = [(dict(k), list(s[0]), s[1], s[2])
+                     for k, s in self._series.items()]
+        return [{"name": self.name, "type": self.kind, "labels": labels,
+                 "buckets": list(self.buckets), "counts": counts,
+                 "sum": total, "count": n}
+                for labels, counts, total, n in items]
 
 
 class MetricsRegistry:
@@ -270,7 +284,16 @@ class MetricsRegistry:
     def snapshot(self) -> list[dict]:
         # span/metric records lost to the ring buffer are data loss the
         # driver must surface (satellite: silent-drop visibility)
-        self.gauge("rlt_telemetry_dropped_total").set(spans.dropped())
+        dropped = spans.dropped()
+        self.gauge("rlt_telemetry_dropped_total").set(dropped)
+        # the same loss as a true Prometheus COUNTER so it is alertable
+        # (rate() > 0 == silent trace loss), not just a summary field +
+        # a driver log line (trace-plane satellite).  spans.dropped() is
+        # monotonic per recorder; the max() guards a recorder restart.
+        c = self.counter("rlt_spans_dropped_total")
+        delta = dropped - c.value()
+        if delta > 0:
+            c.inc(delta)
         # compile-plane counters (persistent-cache hits/misses + real
         # backend-compile seconds) mirror in when that module is live;
         # sys.modules-gated so an unused compile plane costs nothing

@@ -1,0 +1,145 @@
+"""Trace-plane selfcheck for ``format.sh --check`` (CI gate).
+
+Same contract as the comm/compile/serve/elastic selfchecks: cheap,
+deterministic, no pytest, no jax backend — validates the invariants
+that would otherwise only fail deep inside a live fleet:
+
+1. span-record schema: what spans.py emits is exactly what the
+   aggregator/flight/tracing consumers key on;
+2. trace-context round-trip: a driver request span + worker spans
+   (single ``trace`` attr and the decode's ``traces`` fan-out map)
+   reassemble into one tree, and the tenant breakdown attributes the
+   phases;
+3. flight-recorder bounded-size invariant: rings never exceed their
+   capacity no matter how much is ingested, and a dump names the
+   rank's last span;
+4. profile-controller state machine: pending→active→done, second POST
+   rejected while armed;
+5. every new trace-plane instrument name is Prometheus-clean
+   (the PR 2 lint).
+"""
+
+from __future__ import annotations
+
+
+def _check_span_schema() -> None:
+    from ray_lightning_tpu.telemetry import spans
+    from ray_lightning_tpu.telemetry import tracing
+    spans.enable(rank=5, sink=None, flush_every=None)
+    try:
+        with spans.span("step", step=3, trace="abc123"):
+            pass
+        (rec,) = spans.drain()
+        assert rec["t"] == "span" and rec["name"] == "step"
+        assert rec["rank"] == 5 and rec["depth"] == 0
+        assert rec["dur"] >= 0 and isinstance(rec["ts"], float)
+        assert rec["attrs"] == {"step": 3, "trace": "abc123"}
+    finally:
+        spans.disable()
+    synthetic = tracing.span_record("request", 100.0, 100.5,
+                                    trace="abc123", tenant="t")
+    assert synthetic["rank"] == -1 and synthetic["dur"] == 0.5
+    assert set(synthetic) >= {"t", "name", "ts", "dur", "rank", "depth"}
+    print("telemetry selfcheck: span-record schema OK")
+
+
+def _check_trace_roundtrip() -> None:
+    import tempfile
+    from ray_lightning_tpu.telemetry import tracing
+    from ray_lightning_tpu.telemetry.aggregator import TelemetryAggregator
+    agg = TelemetryAggregator(tempfile.mkdtemp(prefix="rlt_sc_"))
+    tid = tracing.mint_trace_id()
+    other = tracing.mint_trace_id()
+    assert tid != other and len(tid) == 16
+    agg.ingest_records(-1, [
+        tracing.span_record("queue_wait", 10.0, 10.2, trace=tid,
+                            tenant="alice"),
+        tracing.span_record("request", 10.0, 11.0, trace=tid,
+                            tenant="alice", status="ok", tokens=4,
+                            queue_s=0.2, ttft_s=0.5, tpot_s=0.1)])
+    agg.ingest_records(0, [
+        tracing.span_record("prefill", 10.2, 10.5, rank=0, trace=tid,
+                            bucket=16),
+        tracing.span_record("decode", 10.5, 10.6, rank=0,
+                            traces={0: tid, 1: other})])
+    trees = agg.request_trees()
+    assert set(trees) == {tid, other}
+    names = [r["name"] for r in trees[tid]]
+    assert names == ["queue_wait", "request", "prefill", "decode"], names
+    assert trees[other] == [trees[tid][-1]]     # fan-out span is shared
+    bd = agg.tenant_breakdown()["alice"]
+    assert bd["requests"] == 1 and bd["failed"] == 0
+    assert bd["queue_wait_p50_ms"] == 200.0
+    assert bd["prefill_p50_ms"] == 300.0
+    assert bd["decode_p50_ms"] == 500.0          # 1.0s total - 0.5 ttft
+    print(f"telemetry selfcheck: trace round-trip OK "
+          f"({len(trees[tid])} spans reassembled)")
+
+
+def _check_flight_bounded() -> None:
+    import json
+    import os
+    import tempfile
+    from ray_lightning_tpu.telemetry.flight import FlightRecorder
+    out = tempfile.mkdtemp(prefix="rlt_sc_flight_")
+    fr = FlightRecorder(out, span_capacity=16, beat_capacity=4)
+    for i in range(500):
+        fr.note_records(1, [{"t": "span", "name": f"step{i}",
+                             "ts": float(i), "dur": 0.01, "rank": 1}])
+        fr.note_heartbeat({"rank": 1, "pid": 9, "wall": float(i),
+                           "last_span": f"step{i}"})
+    # the bounded-size invariant: rings NEVER exceed capacity
+    assert len(fr._records[1]) == 16
+    assert len(fr._beats[1]) == 4
+    path = fr.dump(1, "selfcheck")
+    assert path and os.path.basename(path) == "flight_1.json"
+    doc = json.load(open(path))
+    assert doc["rank"] == 1 and doc["cause"] == "selfcheck"
+    assert doc["last_span"] == "step499"         # newest survives
+    assert len(doc["spans"]) == 16
+    print("telemetry selfcheck: flight-recorder rings bounded "
+          "(16/500 spans kept, newest-first)")
+
+
+def _check_profile_controller() -> None:
+    import tempfile
+    from ray_lightning_tpu.telemetry.tracing import ServeProfileController
+    ctl = ServeProfileController(tempfile.mkdtemp(prefix="rlt_sc_prof_"))
+    assert ctl.status()["state"] == "idle"
+    first = ctl.request(3)
+    assert first["accepted"] and ctl.status()["state"] == "pending"
+    assert not ctl.request(1)["accepted"]        # one window at a time
+    pending = ctl.take_pending()
+    assert pending["steps"] == 3 and ctl.take_pending() is None
+    for _ in range(3):
+        assert ctl.status()["state"] == "active"
+        ctl.note_step()
+    st = ctl.status()
+    assert st["state"] == "done" and st["last_dir"] == pending["dir"]
+    assert ctl.request(1)["accepted"]            # re-armable when done
+    print("telemetry selfcheck: profile controller "
+          "pending->active->done OK")
+
+
+def _check_metric_names() -> None:
+    from ray_lightning_tpu.telemetry.metrics import validate_metric_name
+    for name in ("rlt_spans_dropped_total",
+                 "rlt_serve_queue_wait_seconds",
+                 "rlt_profile_windows_total"):
+        validate_metric_name(name)
+    print("telemetry selfcheck: trace-plane metric names "
+          "Prometheus-clean")
+
+
+def _main(argv: list) -> int:
+    _check_span_schema()
+    _check_trace_roundtrip()
+    _check_flight_bounded()
+    _check_profile_controller()
+    _check_metric_names()
+    return 0
+
+
+if __name__ == "__main__":   # pragma: no cover - exercised via format.sh
+    import sys
+    sys.exit(_main(sys.argv[1:]))

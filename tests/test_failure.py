@@ -138,7 +138,13 @@ def test_elastic_shrink_to_continue_matches_clean_resume(tmp_path):
     so the global batch is preserved, the clean run uses the doubled
     batch directly).  Tolerance: the 2-shard and 1-shard programs
     reduce the same global batch in different summation orders, so
-    equality is allclose, not bitwise."""
+    equality is allclose, not bitwise.
+
+    With telemetry on, the death classification must also dump the
+    killed rank's black box (ISSUE 9 acceptance): flight_1.json under
+    the telemetry dir, naming rank 1, the classified cause, and its
+    last spans (flush_every=1 so the kill cannot outrun the batch
+    threshold)."""
     import jax
     import numpy as np
     from tests.conftest import assert_tree_allclose
@@ -150,12 +156,29 @@ def test_elastic_shrink_to_continue_matches_clean_resume(tmp_path):
         log_every_n_steps=1, default_root_dir=str(tmp_path),
         plugins=[cpu_plugin(
             2, worker_env={"RLT_FAULT": "kill:rank=1,step=5"})],
+        telemetry={"heartbeat_interval": 0.2, "flush_every": 1,
+                   "metrics_interval": 0.5},
         elastic={"snapshot_every_n_steps": 2, "snapshot_dir": snap,
                  "max_restarts": 2})
     module = BoringModel(dataset_length=64, batch_size=2)
     trainer.fit(module)             # the kill must NOT raise here
 
     assert trainer.global_step == 8
+
+    # -- crash flight recorder: the postmortem starts from evidence
+    flight = os.path.join(str(tmp_path), "telemetry", "flight_1.json")
+    assert os.path.exists(flight), \
+        "death classification did not dump the killed rank's black box"
+    import json
+    doc = json.load(open(flight))
+    assert doc["rank"] == 1
+    assert "elastic death classification" in doc["cause"]
+    assert "dead ranks [1]" in doc["cause"]
+    names = {s["name"] for s in doc["spans"]}
+    assert "step" in names, \
+        f"flight dump missing the killed rank's last step spans: {names}"
+    assert all(s.get("rank", 1) == 1 for s in doc["spans"])
+    assert doc["heartbeats"], "no heartbeat trail in the black box"
     rep = trainer._elastic_report
     assert rep["restarts"] == 1
     assert rep["workers"] == 1 and rep["initial_workers"] == 2

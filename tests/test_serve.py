@@ -463,6 +463,79 @@ def test_e2e_two_workers_multi_tenant_live_metrics(tmp_path, seed,
             assert w["compile_cache"]["active"]
             cold_secs.append(w["compile_cache"]["backend_compile_secs"])
 
+        # -- trace plane: every request's span tree reassembles
+        # (queue_wait -> per-bucket prefill -> decode steps -> request)
+        # from driver + worker spans joined by the trace id the plan
+        # broadcast propagated.  Worker batches flush at heartbeat
+        # cadence (0.5s here); poll briefly for the last ones.
+        agg = server._agg
+        deadline = time.monotonic() + 30
+        trees = {}
+        want = {r.trace for r in reqs}
+        while time.monotonic() < deadline:
+            trees = agg.request_trees()
+            if all(
+                {"queue_wait", "prefill", "decode", "request"}
+                <= {s["name"] for s in trees.get(r.trace, ())}
+                    for r in reqs):
+                break
+            time.sleep(0.1)
+        assert want <= set(trees), "not every request traced"
+        for r in reqs:
+            tree = trees[r.trace]
+            names = [s["name"] for s in tree]
+            assert {"queue_wait", "prefill", "decode", "request"} \
+                <= set(names), f"request {r.id} tree incomplete: {names}"
+            # worker spans from the fleet AND driver spans in one tree
+            assert {-1} < {s["rank"] for s in tree}
+            # decode steps fan out: 8 new tokens = 7 decode advances
+            assert sum(1 for n in names if n == "decode") >= 7
+            prefills = [s for s in tree if s["name"] == "prefill"]
+            assert prefills[0]["attrs"]["bucket"] == r.bucket
+        # per-tenant TTFT breakdown (queue vs prefill vs decode) on
+        # /status — the trace plane's live summary surface
+        with urllib.request.urlopen(server.metrics_url + "/status",
+                                    timeout=5) as resp:
+            status = json.loads(resp.read())
+        for tenant in ("alice", "bob"):
+            bd = status["tenants"][tenant]
+            assert bd["requests"] == 3 and bd["failed"] == 0
+            for key in ("queue_wait_p50_ms", "ttft_p50_ms",
+                        "prefill_p50_ms", "decode_p50_ms",
+                        "tpot_p50_ms"):
+                assert bd[key] is not None and bd[key] >= 0, (key, bd)
+        assert status["traced_requests"] >= 6
+
+        # -- on-demand profiling: POST /debug/profile arms a window on
+        # the next plan broadcast; every rank captures a non-empty
+        # jax.profiler trace dir linked from /status
+        post = urllib.request.Request(
+            server.metrics_url + "/debug/profile?steps=2",
+            method="POST")
+        with urllib.request.urlopen(post, timeout=5) as resp:
+            armed = json.loads(resp.read())
+        assert armed["accepted"], armed
+        prof_reqs = [server.submit(np.arange(1, 5), tenant="alice")
+                     for _ in range(2)]
+        for r in prof_reqs:
+            r.result(timeout=180)
+        deadline = time.monotonic() + 30
+        prof = server.profile_status()
+        while time.monotonic() < deadline \
+                and prof.get("state") != "done":
+            time.sleep(0.1)
+            prof = server.profile_status()
+        assert prof["state"] == "done", prof
+        with urllib.request.urlopen(server.metrics_url + "/status",
+                                    timeout=5) as resp:
+            assert json.loads(resp.read())["profile"]["last_dir"] \
+                == armed["dir"]
+        import os
+        for rank in (0, 1):
+            rank_dir = os.path.join(armed["dir"], f"rank{rank}")
+            found = [f for dp, _, fs in os.walk(rank_dir) for f in fs]
+            assert found, f"rank {rank} profiler capture is empty"
+
         # -- graceful drain: no new work admitted, in-flight finishes
         tail = server.submit(np.arange(1, 6), tenant="alice")
         server.drain(timeout=120)

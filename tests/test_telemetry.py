@@ -16,10 +16,12 @@ import pytest
 
 from ray_lightning_tpu import Trainer, telemetry
 from ray_lightning_tpu.models import BoringModel
+from ray_lightning_tpu.telemetry import tracing
 from ray_lightning_tpu.telemetry.aggregator import (
     TelemetryAggregator,
     WorkerHeartbeatTimeout,
 )
+from ray_lightning_tpu.telemetry.flight import FlightRecorder
 from ray_lightning_tpu.telemetry.heartbeat import make_heartbeat
 
 from tests.utils import cpu_plugin
@@ -201,6 +203,203 @@ def test_watchdog_hard_timeout_raises(tmp_path):
     clock[0] = 6.0
     with pytest.raises(WorkerHeartbeatTimeout, match="rank 2"):
         agg.watchdog_check()
+
+
+# -- per-request tracing (telemetry/tracing.py) --------------------------
+
+def test_trace_id_round_trip_driver_worker_aggregator(tmp_path):
+    """THE trace-propagation round-trip: a driver-side request span and
+    worker-side spans carrying the same trace id (the single ``trace``
+    attr and the decode's ``traces`` fan-out map) reassemble into ONE
+    time-ordered tree in the aggregator — exactly the id flow of a
+    serve request (driver plan broadcast -> worker span batch -> queue
+    -> aggregator)."""
+    agg = TelemetryAggregator(str(tmp_path))
+    telemetry.set_active(agg)
+    # worker-side recorder whose sink delivers like the queue channel
+    telemetry.enable(
+        rank=0,
+        sink=lambda recs: agg.maybe_ingest(telemetry.spans_item(0, recs)),
+        flush_every=1)
+    tid = tracing.mint_trace_id()
+    sibling = tracing.mint_trace_id()
+    t0 = time.time()
+    # driver: queue-wait phase (thread-ambient active aggregator)
+    tracing.record_request_span("queue_wait", t0 - 0.3, t0 - 0.1,
+                                trace=tid, tenant="alice", req=0)
+    # worker: per-bucket prefill + one shared decode over two requests
+    with telemetry.span("prefill", trace=tid, bucket=16, slot=2):
+        pass
+    with telemetry.span("decode", traces={2: tid, 3: sibling}, slots=2):
+        pass
+    # driver: completion summary span carrying the attribution
+    tracing.record_request_span("request", t0 - 0.3, t0 + 0.2,
+                                trace=tid, tenant="alice", status="ok",
+                                tokens=8, queue_s=0.2, ttft_s=0.25,
+                                tpot_s=0.03)
+    trees = agg.request_trees()
+    assert set(trees) == {tid, sibling}
+    names = [r["name"] for r in trees[tid]]
+    assert names[0] in ("queue_wait", "request")     # same start ts
+    assert set(names) == {"queue_wait", "request", "prefill", "decode"}
+    # one tree spans BOTH sides of the queue channel
+    assert {r["rank"] for r in trees[tid]} == {-1, 0}
+    # the shared decode span fans out to the sibling's tree too
+    assert [r["name"] for r in trees[sibling]] == ["decode"]
+    # and the per-tenant breakdown attributes the phases
+    bd = agg.tenant_breakdown()["alice"]
+    assert bd["requests"] == 1 and bd["tokens"] == 8
+    assert bd["queue_wait_p50_ms"] == pytest.approx(200.0, abs=1.0)
+    assert bd["ttft_p50_ms"] == pytest.approx(250.0, abs=1.0)
+    assert bd["decode_p50_ms"] == pytest.approx(250.0, abs=1.0)
+    assert bd["prefill_p50_ms"] is not None
+    # the exported summary carries the trace-plane section
+    paths = agg.export()
+    assert paths["summary"]["requests"]["traced"] == 2
+    assert "alice" in paths["summary"]["requests"]["tenants"]
+
+
+def test_tenant_breakdown_counts_failed_requests(tmp_path):
+    agg = TelemetryAggregator(str(tmp_path))
+    t0 = time.time()
+    agg.ingest_records(-1, [
+        tracing.span_record("request", t0, t0 + 1.0, trace="aaaa",
+                            tenant="bob", status="ok", tokens=4,
+                            ttft_s=0.5, queue_s=0.1),
+        tracing.span_record("request", t0, t0 + 2.0, trace="bbbb",
+                            tenant="bob", status="failed", tokens=0,
+                            ttft_s=2.0, queue_s=2.0)])
+    bd = agg.tenant_breakdown()["bob"]
+    assert bd["requests"] == 2 and bd["failed"] == 1
+    # failed requests participate in the percentiles (optimism fix)
+    assert bd["ttft_p99_ms"] == pytest.approx(2000.0, abs=1.0)
+
+
+# -- crash flight recorder (telemetry/flight.py) -------------------------
+
+def test_flight_recorder_bounded_and_dumps(tmp_path):
+    fr = FlightRecorder(str(tmp_path), span_capacity=8, beat_capacity=3)
+    for i in range(100):
+        fr.note_records(2, [{"t": "span", "name": f"step{i}",
+                             "ts": float(i), "dur": 0.01, "rank": 2}])
+        fr.note_heartbeat({"rank": 2, "pid": 1, "wall": float(i),
+                           "last_span": f"step{i}", "dropped": 0})
+    # bounded-size invariant: rings never exceed capacity
+    assert len(fr._records[2]) == 8 and len(fr._beats[2]) == 3
+    path = fr.dump(2, "unit-test cause")
+    assert path == str(tmp_path / "flight_2.json")
+    doc = json.load(open(path))
+    assert doc["rank"] == 2 and doc["cause"] == "unit-test cause"
+    assert doc["last_span"] == "step99"      # newest records survive
+    assert len(doc["spans"]) == 8
+    assert doc["heartbeats"][-1]["last_span"] == "step99"
+    assert fr.dumped[2] == path
+
+
+def test_aggregator_mirrors_into_flight_and_watchdog_dumps(tmp_path):
+    """A wedge verdict dumps the rank's black box: the watchdog's first
+    warning for a silent rank writes flight_<rank>.json with its last
+    spans and heartbeat trail."""
+    clock = [0.0]
+    agg = TelemetryAggregator(str(tmp_path), heartbeat_timeout=5.0,
+                              clock=lambda: clock[0])
+    agg.ingest_records(1, [{"t": "span", "name": "step", "ts": 100.0,
+                            "dur": 0.02, "rank": 1, "depth": 0}])
+    beat = make_heartbeat(1)
+    agg.maybe_ingest(beat)
+    clock[0] = 10.0
+    agg.watchdog_check()
+    path = tmp_path / "flight_1.json"
+    assert path.exists()
+    doc = json.load(open(path))
+    assert doc["rank"] == 1
+    assert "wedge" in doc["cause"]
+    assert doc["last_span"] == "step"
+    assert doc["heartbeats"], "heartbeat trail missing from black box"
+
+
+# -- on-demand profiling (POST /debug/profile) ---------------------------
+
+def test_debug_profile_endpoint_and_status(tmp_path):
+    """POST /debug/profile arms a window on the controller; /status
+    links its state and, with the serve pump's hooks driven, the
+    resulting dir."""
+    import urllib.request
+    from ray_lightning_tpu.telemetry import exporter as _exporter
+    from ray_lightning_tpu.telemetry.tracing import ServeProfileController
+
+    agg = TelemetryAggregator(str(tmp_path))
+    ctl = ServeProfileController(str(tmp_path))
+    server = _exporter.MetricsHTTPServer(agg, port=0,
+                                         profile_controller=ctl).start()
+    try:
+        req = urllib.request.Request(
+            server.url + "/debug/profile?steps=2", method="POST")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            resp = json.loads(r.read())
+        assert resp["accepted"] and resp["steps"] == 2
+        # a second POST while armed is rejected with 409
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    server.url + "/debug/profile?steps=1",
+                    method="POST"), timeout=5)
+        assert exc.value.code == 409
+        # drive the pump hooks: claim the window, count its steps
+        pending = ctl.take_pending()
+        assert pending["id"] == resp["id"]
+        ctl.note_step()
+        ctl.note_step()
+        with urllib.request.urlopen(server.url + "/status",
+                                    timeout=5) as r:
+            status = json.loads(r.read())
+        assert status["profile"]["state"] == "done"
+        assert status["profile"]["last_dir"] == resp["dir"]
+    finally:
+        server.stop()
+
+
+def test_debug_profile_without_controller_is_501(tmp_path):
+    import urllib.request
+    from ray_lightning_tpu.telemetry import exporter as _exporter
+    agg = TelemetryAggregator(str(tmp_path))
+    server = _exporter.MetricsHTTPServer(agg, port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    server.url + "/debug/profile?steps=1",
+                    method="POST"), timeout=5)
+        assert exc.value.code == 501
+    finally:
+        server.stop()
+
+
+def test_fit_profile_control_file_round_trip(tmp_path, monkeypatch):
+    """The fit path's arm: FileProfileController writes the control
+    file, the loop-engine poller (profile_tick) picks it up from the
+    env, captures a real jax.profiler window, and drops the rank done
+    marker /status reports."""
+    control = str(tmp_path / "profile" / "control.json")
+    ctl = tracing.FileProfileController(control)
+    assert ctl.status() == {"state": "idle"}
+    resp = ctl.request(1)
+    assert resp["accepted"] and os.path.exists(control)
+    monkeypatch.setenv(tracing.PROFILE_CONTROL_ENV, control)
+    monkeypatch.setenv("RLT_PROCESS_ID", "0")
+    tracing.reset_profile_tick()
+    try:
+        tracing.profile_tick()       # polls the file, starts the trace
+        tracing.profile_tick()       # counts the step, stops + marks
+        status = ctl.status()
+        assert status["state"] == "done", status
+        assert status["ranks_done"] == ["rank0"]
+        trace_dir = os.path.join(resp["dir"], "rank0")
+        found = [os.path.join(dp, f) for dp, _, fs in os.walk(trace_dir)
+                 for f in fs]
+        assert found, "profiler window wrote no trace files"
+    finally:
+        tracing.reset_profile_tick()
 
 
 # -- trainer integration -------------------------------------------------
