@@ -75,22 +75,15 @@ def main() -> None:
                       trace_steps=trace_steps, inline_device_ms=True)
 
     if os.environ.get("RLT_COMM_AB") == "1":
-        # A/B leg: the same config with int8 gradient collectives on the
-        # data axis (comm/) — prints a second JSON line whose ``comm``
-        # field is "int8" so rounds can track the compressed path's
-        # steps/sec next to the fp32 number of record.  Meaningful on a
-        # multi-device data mesh; on one chip the policy is inert and
-        # the leg measures pure overhead (none expected).
-        from ray_lightning_tpu.comm import CommPolicy
-        module_ab = GPTLightningModule(
-            cfg,
-            dataset_size=batch * (WARMUP_STEPS + TIMED_STEPS + trace_steps),
-            batch_size=batch)
-        run_steps_per_sec(
-            module_ab, metric + "_comm_int8", warmup=WARMUP_STEPS,
-            timed=TIMED_STEPS, baseline=BASELINES.get(metric),
-            trainer_kwargs={"comm_policy": CommPolicy(
-                compress="int8", axes=("data",))})
+        # comm-plane A/B legs (benchmarks/bench_comm.py): fp32 floor,
+        # flat int8, hierarchical int8/fp8/int4, and the bucketed-vs-
+        # barrier overlap pair — one JSON line per leg with
+        # ``exposed_comm_seconds`` (wall minus the fp32 floor) so the
+        # tentpole's overlap win is a single diff.  Runs inline on a
+        # multi-device mesh; a single-device session re-runs the legs
+        # on the 8-virtual-device CPU proxy in a subprocess.
+        from benchmarks.bench_comm import run_comm_ab
+        run_comm_ab(metric + "_comm")
 
 
 if __name__ == "__main__":

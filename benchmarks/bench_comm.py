@@ -1,0 +1,140 @@
+"""Comm-plane A/B bench: flat / hierarchical / fp8 / int4 legs plus the
+exposed-vs-overlapped comm measurement.
+
+``bench.py`` runs this when ``RLT_COMM_AB=1``.  Each leg is ONE JSON
+line in the shared harness format with two extra fields:
+
+- ``exposed_comm_seconds``: this leg's wall seconds/step minus the
+  comm-off (fp32) floor measured in the same process on the same mesh —
+  the differential cost the gradient sync ADDS per step after whatever
+  overlap the schedule achieved.  The tentpole's win is the single diff
+  ``int8_bucketed.exposed_comm_seconds <
+  int8_barrier.exposed_comm_seconds`` (same codec, same bytes; the only
+  difference is the end-of-backward ``optimization_barrier`` the
+  barrier leg re-inserts).
+- ``step_seconds``: the raw wall seconds/step the subtraction started
+  from, so rounds can recompute against any floor.
+
+A meaningful A/B needs a real multi-device data mesh.  When the
+current process has one (a TPU slice / multi-host fleet), the legs run
+inline; on a single-device (or CPU) session the whole suite re-runs in
+a subprocess with an 8-virtual-device CPU mesh — the same proxy the
+test suite audits — so ``RLT_COMM_AB=1 python bench.py`` always emits
+comparable legs.  The bucketed/barrier pair additionally feeds
+``rlt_comm_exposed_seconds`` via the metrics plane when telemetry is
+live.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+WARMUP = 3
+TIMED = 20
+
+#: (leg tag, CommPolicy kwargs); hierarchy=4 on the 8-way proxy mesh
+#: (auto would be inert in one process), HIER_AUTO on real fleets —
+#: resolved in ``_legs``.
+LEG_SPECS = (
+    ("int8", dict(compress="int8")),
+    ("int8_hier", dict(compress="int8", hierarchy=True)),
+    ("fp8_hier", dict(compress="fp8", hierarchy=True)),
+    ("int4_hier", dict(compress="int4", hierarchy=True)),
+    ("int8_bucketed", dict(compress="int8", bucket_bytes=1 << 20)),
+    ("int8_barrier", dict(compress="int8", bucket_bytes=1 << 20,
+                          barrier_sync=True)),
+)
+
+
+def _legs(world: int, multi_process: bool):
+    """Resolve LEG_SPECS into CommPolicy objects for this topology."""
+    from ray_lightning_tpu.comm import CommPolicy
+    from ray_lightning_tpu.comm.policy import HIER_AUTO
+
+    hier = HIER_AUTO if multi_process else \
+        next((k for k in (4, 2) if world % k == 0 and k < world), 0)
+    legs = []
+    for tag, spec in LEG_SPECS:
+        kw = dict(spec)
+        if kw.pop("hierarchy", False):
+            if not hier:
+                continue          # no two-tier split exists here
+            kw["hierarchy"] = hier
+        legs.append((tag, CommPolicy(axes=("data",), **kw)))
+    return legs
+
+
+def run_comm_ab(metric_prefix: str = "comm_ab") -> None:
+    """Emit every comm A/B leg (inline on a multi-device mesh, else via
+    the CPU-mesh proxy subprocess)."""
+    import jax
+
+    if jax.device_count() >= 2:
+        _run_legs_inline(metric_prefix)
+        return
+    # single-device session: 8-virtual-device CPU proxy in a child
+    # process (the XLA flag must precede backend init, hence the spawn)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RLT_COMM_AB_METRIC"] = f"{metric_prefix}_cpu_proxy8"
+    subprocess.run([sys.executable, "-m", "benchmarks.bench_comm"],
+                   env=env, check=True)
+
+
+def _run_legs_inline(metric_prefix: str) -> None:
+    import jax
+
+    from benchmarks.harness import run_steps_per_sec
+    from ray_lightning_tpu.models.gpt import GPTLightningModule
+    from ray_lightning_tpu.telemetry import metrics as _metrics
+
+    world = jax.device_count()
+    multi = jax.process_count() > 1
+    batch = max(8, world)
+    steps = WARMUP + TIMED + 4
+
+    def leg(tag, policy, extra=None):
+        module = GPTLightningModule("tiny", dataset_size=batch * steps,
+                                    batch_size=batch)
+        kwargs = {"comm_policy": policy} if policy is not None else {}
+        return run_steps_per_sec(
+            module, f"{metric_prefix}_{tag}", warmup=WARMUP, timed=TIMED,
+            trainer_kwargs=kwargs, telemetry=False, extra_fields=extra)
+
+    # comm-off floor: the same model/mesh with the partitioner's
+    # implicit fp32 sync — every leg's exposed seconds subtract it
+    floor = leg("fp32", None)
+    floor_s = 1.0 / floor["value"]
+
+    def differential(res):
+        step_s = 1.0 / res["value"]
+        return {"step_seconds": round(step_s, 6),
+                "exposed_comm_seconds": round(step_s - floor_s, 6)}
+
+    exposed = {}
+    for tag, policy in _legs(world, multi):
+        res = leg(tag, policy, extra=differential)
+        exposed[tag] = res["exposed_comm_seconds"]
+    if "int8_bucketed" in exposed and "int8_barrier" in exposed:
+        _metrics.note_exposed_comm(max(exposed["int8_bucketed"], 0.0))
+        print(json.dumps({
+            "metric": f"{metric_prefix}_overlap_win",
+            "barrier_exposed_s": round(exposed["int8_barrier"], 6),
+            "bucketed_exposed_s": round(exposed["int8_bucketed"], 6),
+            "overlap_wins": bool(exposed["int8_bucketed"]
+                                 < exposed["int8_barrier"]),
+        }))
+
+
+def main() -> None:
+    _run_legs_inline(os.environ.get("RLT_COMM_AB_METRIC", "comm_ab"))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,7 +22,8 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
                       strategy=None, trainer_kwargs=None,
                       trace_steps: int = 0,
                       inline_device_ms: bool = False,
-                      telemetry: bool = True) -> dict:
+                      telemetry: bool = True,
+                      extra_fields: "dict | None" = None) -> dict:
     """Time steady-state steps; optionally profile a WARM tail.
 
     ``trace_steps > 0``: after the timed window closes (and its sync
@@ -159,6 +160,12 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
         timer.trace_dir = None
         if med is not None:
             result["device_ms"] = round(med, 2)
+    if callable(extra_fields):
+        # derived fields (e.g. bench_comm's exposed_comm_seconds need
+        # the measured value): compute from the assembled result
+        result.update(extra_fields(result) or {})
+    elif extra_fields:
+        result.update(extra_fields)
     print(json.dumps(result))
     if timer.trace_dir is not None:
         result["trace_dir"] = timer.trace_dir

@@ -6,24 +6,31 @@ low precision recovers most of that bandwidth at negligible quality
 cost.  This package is the userland version of that idea for the
 framework's sharding-annotation strategies:
 
-- :mod:`quant` — blockwise int8 / bf16 quantize–dequantize kernels in
-  pure ``jax.numpy``/``lax`` (per-block scales, optional stochastic
-  rounding) that fuse into the jitted step.
+- :mod:`quant` — blockwise int8 / bf16 / fp8-e4m3 / packed-int4
+  quantize–dequantize kernels in pure ``jax.numpy``/``lax`` (per-block
+  scales, optional stochastic rounding) that fuse into the jitted step.
 - :mod:`collectives` — ``compressed_psum`` / ``compressed_reduce_scatter``
   / ``compressed_all_gather`` built from ``all_to_all`` + ``all_gather``
   over a named mesh axis in the compressed dtype (summation always
-  accumulates in fp32 — an int8 ``psum`` would wrap), plus
+  accumulates in fp32 — an int8 ``psum`` would wrap), the two-level
+  ``hierarchical_psum`` (fp32 inside the fast ICI group, codec only
+  across the DCN replica groups), and
   :class:`~ray_lightning_tpu.comm.collectives.GradSync`, the object a
-  strategy's ``grad_transform(mesh, policy)`` hands the step builder.
-  Quantization error is carried as an **error-feedback residual** in the
-  optimizer state and re-injected into the next step's gradients.
+  strategy's ``grad_transform(mesh, policy)`` hands the step builder —
+  per-leaf or bucketed (``bucket_bytes``: overlap-schedulable
+  per-bucket collectives).  Quantization error is carried as an
+  **error-feedback residual** in the optimizer state and re-injected
+  into the next step's gradients.
 - :mod:`policy` — :class:`CommPolicy` (``Trainer(comm_policy=...)`` /
-  ``RLT_COMM*`` env knobs): which mesh axes compress, block size,
-  rounding mode, error feedback, and the ZeRO-1 updated-param
-  all-gather dtype.
-- :mod:`audit` — HLO wire-byte accounting used by the collective audits
+  ``RLT_COMM*`` env knobs): which mesh axes compress, codec, block
+  size, rounding mode, error feedback, hierarchy split, bucket target,
+  and the ZeRO-1 updated-param all-gather dtype.
+- :mod:`audit` — HLO wire-byte accounting (now per link tier, over
+  each collective's replica groups) used by the collective audits
   (tests/test_collective_audit.py) to prove the compressed programs
-  actually move fewer bytes.
+  actually move fewer bytes — and fewer DCN-crossing bytes.
+- :mod:`calibrate` — measured link bandwidths replacing the cost-model
+  constants (``RLT_PLAN_CALIBRATE=1``; cached per topology).
 
 Off by default: with the policy unresolved (or no compressible axis on
 the mesh) every strategy's ``grad_transform`` returns ``None`` and the
@@ -37,6 +44,9 @@ from ray_lightning_tpu.comm.collectives import (  # noqa: F401
     compressed_all_gather,
     compressed_psum,
     compressed_reduce_scatter,
+    hierarchical_psum,
+    hierarchy_groups,
+    partition_buckets,
 )
 from ray_lightning_tpu.comm.policy import CommPolicy  # noqa: F401
 from ray_lightning_tpu.comm.quant import (  # noqa: F401

@@ -51,6 +51,33 @@ _DEF_RE = re.compile(
 
 _SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
 
+#: replica_groups attribute: explicit list "{{0,1},{2,3}}" or the iota
+#: form "[4,2]<=[8]" (optionally with a transpose "T(1,0)")
+_GROUPS_LIST_RE = re.compile(r"replica_groups=\{(\{[0-9, ]*\}(?:,\{"
+                             r"[0-9, ]*\})*)\}")
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[([0-9,]+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?")
+
+
+def _parse_groups(def_line: str):
+    """The ``replica_groups`` of one collective definition line as a
+    list of rank lists, or ``None`` for a full-span collective (no
+    groups / unparseable — charged as crossing every tier)."""
+    m = _GROUPS_LIST_RE.search(def_line)
+    if m:
+        return [[int(r) for r in g.split(",") if r.strip()]
+                for g in re.findall(r"\{([0-9, ]*)\}", m.group(1))]
+    m = _GROUPS_IOTA_RE.search(def_line)
+    if m:
+        import numpy as _np
+        lhs = [int(d) for d in m.group(1).split(",")]
+        rhs = [int(d) for d in m.group(2).split(",")]
+        arr = _np.arange(int(_np.prod(rhs))).reshape(rhs)
+        if m.group(3):
+            arr = arr.transpose([int(p) for p in m.group(3).split(",")])
+        return [list(map(int, row)) for row in arr.reshape(lhs)]
+    return None
+
 
 def _shape_bytes(dtype: str, dims: str) -> int:
     n = 1
@@ -68,13 +95,23 @@ def collective_defs(hlo_text: str):
     async ``-start`` tuples repeat the operand alongside the result, so
     their sum is halved to keep start/done and sync forms comparable.
     """
+    for op, dtypes, total, _groups in collective_defs_with_groups(hlo_text):
+        yield op, dtypes, total
+
+
+def collective_defs_with_groups(hlo_text: str):
+    """:func:`collective_defs` plus each definition's parsed
+    ``replica_groups`` (``None`` = full span) — the raw material of the
+    per-link attribution below."""
     for m in _DEF_RE.finditer(hlo_text):
         shapes, op, started = m.group(1), m.group(2), m.group(3)
         parts = _SHAPE_RE.findall(shapes)
         total = sum(_shape_bytes(dt, dims) for dt, dims in parts)
         if started and len(parts) >= 2 and len(parts) % 2 == 0:
             total //= 2
-        yield op, {dt for dt, _ in parts}, total
+        eol = hlo_text.find("\n", m.end())
+        def_line = hlo_text[m.end():eol if eol >= 0 else len(hlo_text)]
+        yield op, {dt for dt, _ in parts}, total, _parse_groups(def_line)
 
 
 def collective_wire_bytes(hlo_text: str,
@@ -108,6 +145,56 @@ def total_wire_bytes(hlo_text: str, axis_size: int = 1, *,
             continue
         total += b
     return total
+
+
+# -- per-link attribution (hierarchical collectives) -----------------------
+
+
+def crosses_dcn(groups, ici_size: int) -> bool:
+    """Whether a collective's replica groups span hosts, given
+    ``ici_size`` consecutive ranks per host (the contiguous-block
+    layout ``hierarchy_groups`` / the process-major device order
+    imply).  Group-less (full-span) collectives cross by definition."""
+    if not groups:
+        return True
+    return any(len({r // ici_size for r in g}) > 1 for g in groups)
+
+
+def wire_bytes_by_link(hlo_text: str, ici_size: int, axis_size: int = 1, *,
+                       ops=None, dtypes=None) -> Dict[str, int]:
+    """``{"ici": bytes, "dcn": bytes}`` over every collective definition
+    in ``hlo_text``: a collective whose every replica group stays
+    within one ``ici_size``-rank host block charges the fast tier,
+    anything spanning hosts (or group-less) charges DCN.  This is the
+    audit side of the hierarchical declaration — tests pin the two-level
+    programs' DCN-crossing bytes against the flat paths with it.
+    Filters and the ring-cost factors match :func:`total_wire_bytes`."""
+    out = {"ici": 0, "dcn": 0}
+    for op, dts, nbytes, groups in collective_defs_with_groups(hlo_text):
+        if ops is not None and op not in ops:
+            continue
+        dtype = max(dts, key=lambda d: _ITEMSIZE.get(d, 4)) if dts else "f32"
+        if dtypes is not None and dtype not in dtypes:
+            continue
+        factor = _WIRE_FACTOR[op]
+        wire = (nbytes * axis_size if factor is None
+                else int(nbytes * factor))
+        out["dcn" if crosses_dcn(groups, ici_size) else "ici"] += wire
+    return out
+
+
+def declared_dcn_bytes(op_bytes: dict, multi_process: bool) -> int:
+    """DCN-crossing bytes of a ``step_collective_bytes`` declaration:
+    the ``_dcn``-suffixed ops when the hierarchical sync attributed
+    them, else (multi-process — the data axis spans hosts) everything
+    not explicitly pinned to ICI.  Single-process runs have no DCN hop
+    at all.  Feeds ``rlt_comm_dcn_bytes_total``."""
+    dcn = sum(b for op, b in (op_bytes or {}).items()
+              if op.endswith("_dcn"))
+    if dcn == 0 and multi_process:
+        dcn = sum(b for op, b in (op_bytes or {}).items()
+                  if not op.endswith("_ici"))
+    return int(dcn)
 
 
 # -- byte → seconds (planner cost model) -----------------------------------

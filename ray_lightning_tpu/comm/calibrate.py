@@ -1,0 +1,164 @@
+"""Measured-bandwidth calibration for the byte→seconds cost model.
+
+The planner's ranking (plan/cost.py) and the audit's byte→seconds
+conversion (comm/audit.py) run on per-link GB/s constants that are
+deliberately coarse — right order of magnitude per fabric generation,
+wrong for any particular deployment.  ``RLT_PLAN_CALIBRATE=1`` replaces
+them with MEASURED values: a tiny collective microbench (one fp32
+all-reduce per link tier, a few repeats, first dispatch discarded as
+compile) runs once and caches its result as JSON keyed by the exact
+topology fingerprint, so every later fit/plan on the same machine reads
+the file instead of re-measuring.
+
+Cache location (first match wins): the explicit ``cache_dir`` argument,
+``$RLT_CALIBRATE_DIR``, ``$RLT_TELEMETRY_DIR`` (the telemetry artifact
+dir when the caller exports one), else ``~/.cache/ray_lightning_tpu``.
+
+Links measured:
+
+- **ICI**: all-reduce across this process's local devices (needs >= 2;
+  a single-chip host keeps the constant).  On the CPU test mesh this
+  measures the host's memcpy fabric — not a TPU number, but exactly
+  what a CPU-mesh plan should rank with.
+- **DCN**: all-reduce across processes (needs ``jax.process_count() >
+  1``; single-process runs keep the constant — there is no DCN hop to
+  measure).
+
+Never raises into the planner: any measurement failure falls back to
+the audit constants and records why.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+from ray_lightning_tpu.comm.audit import DCN_GBPS, ICI_GBPS
+
+_log = logging.getLogger(__name__)
+
+#: payload of the microbench collective (fp32 elements).  8 MiB: big
+#: enough to be bandwidth- not latency-bound on both tiers, small
+#: enough to be instant anywhere.
+PAYLOAD_ELEMENTS = 2 * 1024 * 1024
+REPEATS = 5
+
+ENV_DIR = "RLT_CALIBRATE_DIR"
+
+
+def _cache_dir(cache_dir: Optional[str]) -> str:
+    return (cache_dir or os.environ.get(ENV_DIR)
+            or os.environ.get("RLT_TELEMETRY_DIR")
+            or os.path.join(os.path.expanduser("~"), ".cache",
+                            "ray_lightning_tpu"))
+
+
+def topology_fingerprint() -> str:
+    import jax
+    dev = jax.devices()[0]
+    return (f"jax{jax.__version__}-{dev.platform}-"
+            f"{getattr(dev, 'device_kind', 'cpu').replace(' ', '_')}-"
+            f"d{jax.device_count()}-p{jax.process_count()}")
+
+
+def cache_path(cache_dir: Optional[str] = None) -> str:
+    return os.path.join(_cache_dir(cache_dir),
+                        f"bandwidth_{topology_fingerprint()}.json")
+
+
+def _time_allreduce(devices) -> "tuple[float, int]":
+    """(seconds per all-reduce, per-rank wire bytes) over ``devices``
+    under the audit's ring model (all-reduce = 2 x result bytes)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    n = len(devices)
+    mesh = Mesh(np.asarray(devices, dtype=object).reshape(n), ("x",))
+    x = jax.device_put(
+        np.ones((n, PAYLOAD_ELEMENTS // n), np.float32),
+        NamedSharding(mesh, P("x")))
+
+    @jax.jit
+    def allreduce(v):
+        return jnp.broadcast_to(jnp.sum(v, axis=0, keepdims=True),
+                                v.shape)
+
+    allreduce(x).block_until_ready()          # compile outside the clock
+    t0 = time.monotonic()
+    for _ in range(REPEATS):
+        out = allreduce(x)
+    out.block_until_ready()
+    per_op = (time.monotonic() - t0) / REPEATS
+    wire_bytes = 2 * 4 * PAYLOAD_ELEMENTS     # ring all-reduce, fp32
+    return per_op, wire_bytes
+
+
+def measure_bandwidths() -> dict:
+    """One measurement pass (no cache): ``{"ici_gbps", "dcn_gbps",
+    "measured": [...], "fingerprint", ...}`` with un-measurable links
+    left at the audit constants."""
+    import jax
+
+    result = {
+        "fingerprint": topology_fingerprint(),
+        "ici_gbps": ICI_GBPS,
+        "dcn_gbps": DCN_GBPS,
+        "measured": [],
+        "payload_bytes": 4 * PAYLOAD_ELEMENTS,
+    }
+    local = jax.local_devices()
+    if len(local) >= 2:
+        try:
+            secs, wire = _time_allreduce(local)
+            result["ici_gbps"] = round(wire / secs / 1e9, 3)
+            result["ici_seconds"] = secs
+            result["measured"].append("ici")
+        except Exception as e:   # noqa: BLE001 - calibration never fails
+            result["ici_error"] = repr(e)
+    if jax.process_count() > 1:
+        try:
+            secs, wire = _time_allreduce(jax.devices())
+            result["dcn_gbps"] = round(wire / secs / 1e9, 3)
+            result["dcn_seconds"] = secs
+            result["measured"].append("dcn")
+        except Exception as e:   # noqa: BLE001
+            result["dcn_error"] = repr(e)
+    return result
+
+
+def calibrated_gbps(cache_dir: Optional[str] = None,
+                    force: bool = False) -> "tuple[float, float]":
+    """``(ici_gbps, dcn_gbps)`` from the topology-keyed cache file,
+    measuring (and writing the cache) on first use.  Falls back to the
+    audit constants on any failure — the planner must always get a
+    number."""
+    path = cache_path(cache_dir)
+    if not force:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            return float(data["ici_gbps"]), float(data["dcn_gbps"])
+        except FileNotFoundError:
+            pass
+        except Exception as e:   # noqa: BLE001 - corrupt cache: remeasure
+            _log.warning("bandwidth cache %s unreadable (%r); remeasuring",
+                         path, e)
+    try:
+        data = measure_bandwidths()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        _log.info("calibrated link bandwidths %s -> %s",
+                  {k: data[k] for k in ("ici_gbps", "dcn_gbps")}, path)
+        return float(data["ici_gbps"]), float(data["dcn_gbps"])
+    except Exception as e:   # noqa: BLE001 - constants beat a crash
+        _log.warning("bandwidth calibration failed (%r); using the "
+                     "audit constants", e)
+        return ICI_GBPS, DCN_GBPS

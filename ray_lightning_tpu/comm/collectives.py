@@ -20,6 +20,15 @@ dtype:
   wire phases compressed.  Per-rank wire bytes ≈ 2·n·itemsize(mode)
   versus the fp32 ring's 2·n·4 — the ~4x (int8) / 2x (bf16) the HLO
   audit pins.
+- :func:`hierarchical_psum` — the two-level EQuARX split: when the
+  reduction axis spans both a fast tier (ICI — chips sharing a host)
+  and a slow one (DCN — cross-host), reduce fp32 within each ICI
+  group first (all_to_all → local sum: a full-precision
+  reduce-scatter), run the COMPRESSED psum only across the DCN groups
+  on the 1/ici-sized shard, and fp32 all-gather back inside the ICI
+  group.  Only inter-host bytes pay the codec, and they also shrink by
+  the extra factor ``ici`` — so for the same DCN wire savings the
+  error-feedback residual absorbs strictly less quantization noise.
 
 Error feedback: the phase-1 local quantization error (``x − dq(q(x))``)
 is returned alongside the result; :class:`GradSync` stores it per-rank
@@ -83,20 +92,24 @@ def compressed_reduce_scatter(x: jax.Array, axis, world: int, *,
                               mode: str = "int8", block_size: int = 64,
                               stochastic: bool = False,
                               rng: Optional[jax.Array] = None,
-                              with_error: bool = False):
+                              with_error: bool = False,
+                              groups: Optional[list] = None):
     """Inside ``shard_map``: reduce-scatter ``x`` (any shape) over
     ``axis`` in the compressed dtype.  Returns ``(shard, n)`` — this
     rank's fp32 ``[chunk]`` shard of the SUM and the true element count
     — plus the local quantization error (shaped like ``x``) when
-    ``with_error``."""
+    ``with_error``.  ``groups`` (``axis_index_groups``) restricts the
+    exchange to subgroups of ``world`` ranks each (the hierarchical
+    DCN tier)."""
     axes = _axis_arg(axis)
     rows, n = _pad_rows(x, world, block_size)
     q, scale = compress_cast(rows, mode, block_size,
                              stochastic=stochastic, rng=rng)
-    qt = lax.all_to_all(q, axes, split_axis=0, concat_axis=0, tiled=True)
+    qt = lax.all_to_all(q, axes, split_axis=0, concat_axis=0, tiled=True,
+                        axis_index_groups=groups)
     if scale is not None:
         st = lax.all_to_all(scale, axes, split_axis=0, concat_axis=0,
-                            tiled=True)
+                            tiled=True, axis_index_groups=groups)
         shard = jnp.sum(decompress_cast(qt, st, mode, block_size), axis=0)
     else:
         shard = jnp.sum(qt.astype(jnp.float32), axis=0)
@@ -110,16 +123,19 @@ def compressed_reduce_scatter(x: jax.Array, axis, world: int, *,
 def compressed_all_gather(shard: jax.Array, axis, world: int, *,
                           mode: str = "int8", block_size: int = 64,
                           stochastic: bool = False,
-                          rng: Optional[jax.Array] = None) -> jax.Array:
+                          rng: Optional[jax.Array] = None,
+                          groups: Optional[list] = None) -> jax.Array:
     """Inside ``shard_map``: all-gather a per-rank ``[chunk]`` shard over
-    ``axis`` in the compressed dtype.  Returns the flat fp32
-    ``[world * chunk]`` result (replicated across the axis)."""
+    ``axis`` (or its ``groups`` subgroups) in the compressed dtype.
+    Returns the flat fp32 ``[world * chunk]`` result (replicated across
+    the axis/group)."""
     axes = _axis_arg(axis)
     q, scale = compress_cast(shard[None], mode, block_size,
                              stochastic=stochastic, rng=rng)
-    qg = lax.all_gather(q, axes, tiled=True)
+    qg = lax.all_gather(q, axes, tiled=True, axis_index_groups=groups)
     if scale is not None:
-        sg = lax.all_gather(scale, axes, tiled=True)
+        sg = lax.all_gather(scale, axes, tiled=True,
+                            axis_index_groups=groups)
         full = decompress_cast(qg, sg, mode, block_size)
     else:
         full = qg.astype(jnp.float32)
@@ -130,12 +146,14 @@ def compressed_psum(x: jax.Array, axis, world: int, *,
                     mode: str = "int8", block_size: int = 64,
                     mean: bool = False, stochastic: bool = False,
                     rng: Optional[jax.Array] = None,
-                    with_error: bool = False):
-    """Inside ``shard_map``: all-reduce ``x`` over ``axis`` with both
-    wire phases compressed (reduce-scatter + all-gather).  Returns the
-    reduced array shaped like ``x`` (and the local phase-1 quantization
-    error when ``with_error`` — in SUM units, i.e. NOT divided by
-    ``world`` even under ``mean``, which is what error feedback needs)."""
+                    with_error: bool = False,
+                    groups: Optional[list] = None):
+    """Inside ``shard_map``: all-reduce ``x`` over ``axis`` (or its
+    ``groups`` subgroups of ``world`` ranks each) with both wire phases
+    compressed (reduce-scatter + all-gather).  Returns the reduced
+    array shaped like ``x`` (and the local phase-1 quantization error
+    when ``with_error`` — in SUM units, i.e. NOT divided by ``world``
+    even under ``mean``, which is what error feedback needs)."""
     r1 = rng
     r2 = None
     if rng is not None:
@@ -143,17 +161,120 @@ def compressed_psum(x: jax.Array, axis, world: int, *,
     out = compressed_reduce_scatter(x, axis, world, mode=mode,
                                     block_size=block_size,
                                     stochastic=stochastic, rng=r1,
-                                    with_error=with_error)
+                                    with_error=with_error, groups=groups)
     shard, n = out[0], out[1]
     if mean:
         shard = shard / world
     full = compressed_all_gather(shard, axis, world, mode=mode,
                                  block_size=block_size,
-                                 stochastic=stochastic, rng=r2)
+                                 stochastic=stochastic, rng=r2,
+                                 groups=groups)
     res = full[:n].reshape(x.shape)
     if with_error:
         return res, out[2]
     return res
+
+
+# ---------------------------------------------------------------------------
+# two-level (ICI x DCN) reduction
+# ---------------------------------------------------------------------------
+
+
+def hierarchy_groups(ici: int, dcn: int) -> "tuple[list, list]":
+    """``(ici_groups, dcn_groups)`` over a ``world = ici * dcn`` axis
+    under the contiguous-block layout ``rank = host * ici + local``
+    (how the mesh builder orders ``jax.devices()``: process-major, so
+    ranks sharing a host are adjacent).  ICI groups are the per-host
+    blocks; DCN groups collect the ranks with the same local index
+    across hosts."""
+    ici_groups = [[h * ici + j for j in range(ici)] for h in range(dcn)]
+    dcn_groups = [[h * ici + j for h in range(dcn)] for j in range(ici)]
+    return ici_groups, dcn_groups
+
+
+def hierarchical_psum(x: jax.Array, axis, ici: int, dcn: int, *,
+                      mode: str = "int8", block_size: int = 64,
+                      mean: bool = False, stochastic: bool = False,
+                      rng: Optional[jax.Array] = None,
+                      with_error: bool = False):
+    """Inside ``shard_map``: two-level all-reduce of ``x`` over an
+    ``ici * dcn``-rank axis (module docstring).  Level 1 reduce-scatters
+    fp32 inside each ICI group (fast link, no codec), level 2 runs
+    :func:`compressed_psum` across the DCN groups on the 1/ici-sized
+    shard (slow link — the ONLY bytes that pay the quantization), level
+    3 fp32 all-gathers inside the ICI group.  ``with_error`` returns
+    the level-2 quantization error scattered back to ``x``'s shape
+    (zeros outside this rank's shard) so the error-feedback residual
+    keeps its flat-path layout: level 1 is an exact sum, so injecting
+    the error into any single rank of the host group next step
+    compensates exactly."""
+    axes = _axis_arg(axis)
+    world = ici * dcn
+    ici_groups, dcn_groups = hierarchy_groups(ici, dcn)
+    # level 1: full-precision reduce-scatter inside the fast ICI group
+    rows, n = _pad_rows(x, ici, block_size)
+    rows_t = lax.all_to_all(rows, axes, split_axis=0, concat_axis=0,
+                            tiled=True, axis_index_groups=ici_groups)
+    shard = jnp.sum(rows_t, axis=0)          # fp32 [chunk] of the host sum
+    # level 2: compressed all-reduce across the slow DCN link only
+    out = compressed_psum(shard, axis, dcn, mode=mode,
+                          block_size=block_size, stochastic=stochastic,
+                          rng=rng, with_error=with_error,
+                          groups=dcn_groups)
+    reduced, err = (out if with_error else (out, None))
+    if mean:
+        reduced = reduced / world
+    # level 3: fp32 all-gather back inside the ICI group
+    full = lax.all_gather(reduced, axes, tiled=True,
+                          axis_index_groups=ici_groups)
+    res = full[:n].reshape(x.shape)
+    if not with_error:
+        return res
+    # scatter this rank's shard-local error back to the param shape:
+    # the group-local index selects which chunk this rank quantized
+    local = _combined_axis_index(axes) % ici
+    chunk = shard.size
+    err_flat = jnp.zeros((ici * chunk,), jnp.float32)
+    err_flat = lax.dynamic_update_slice(err_flat, err.ravel(),
+                                        (local * chunk,))
+    return res, err_flat[:n].reshape(x.shape)
+
+
+def _combined_axis_index(axes):
+    """Index along the (possibly multi-)axis product inside shard_map."""
+    if isinstance(axes, str):
+        return lax.axis_index(axes)
+    idx = jnp.zeros((), jnp.int32)
+    for a in axes:
+        idx = idx * lax.psum(1, a) + lax.axis_index(a)
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# bucket partitioning (comm/compute overlap scheduling)
+# ---------------------------------------------------------------------------
+
+
+def partition_buckets(leaf_bytes, bucket_bytes: int) -> "list[list[int]]":
+    """Greedy contiguous partition of leaf indices into buckets whose
+    cumulative payload reaches ``bucket_bytes`` (the last bucket may be
+    smaller; a single oversized leaf gets its own bucket).  Every index
+    appears exactly once, in order — the invariant comm/selfcheck.py
+    pins."""
+    if bucket_bytes <= 0:
+        return [[i] for i in range(len(leaf_bytes))]
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    acc = 0
+    for i, b in enumerate(leaf_bytes):
+        cur.append(i)
+        acc += int(b)
+        if acc >= bucket_bytes:
+            buckets.append(cur)
+            cur, acc = [], 0
+    if cur:
+        buckets.append(cur)
+    return buckets
 
 
 # ---------------------------------------------------------------------------
@@ -189,6 +310,10 @@ class GradSync:
         self.axes = tuple(axes)
         self.policy = policy
         self.world = int(np.prod([mesh.shape[a] for a in self.axes]))
+        #: two-level split of the reduction axis (policy.hierarchy):
+        #: (1, world) = flat, else ici * dcn == world and only the DCN
+        #: tier carries the codec
+        self.ici_size, self.dcn_size = policy.resolved_hierarchy(self.world)
         #: reduction axes the policy left uncompressed (fp32 pmean)
         self.plain_axes = tuple(
             a for a in data_axis_names
@@ -204,9 +329,19 @@ class GradSync:
     def error_feedback(self) -> bool:
         return bool(self.policy.error_feedback)
 
+    @property
+    def hierarchical(self) -> bool:
+        return self.ici_size > 1 and self.dcn_size > 1
+
     def describe(self) -> str:
-        """Short tag for bench JSON / logs, e.g. ``int8[data]``."""
-        return f"{self.policy.compress}[{','.join(self.axes)}]"
+        """Short tag for bench JSON / logs, e.g. ``int8[data]`` or
+        ``fp8[data]/hier4x2/bkt4M``."""
+        tag = f"{self.policy.compress}[{','.join(self.axes)}]"
+        if self.hierarchical:
+            tag += f"/hier{self.ici_size}x{self.dcn_size}"
+        if self.policy.bucket_bytes > 0:
+            tag += f"/bkt{self.policy.bucket_bytes >> 20}M"
+        return tag
 
     def _comm_kw(self) -> dict:
         return dict(mode=self.policy.compress,
@@ -298,29 +433,43 @@ class GradSync:
             return x
         return jax.tree_util.tree_map(leaf, tree)
 
+    def _psum(self, x, rng, with_error: bool):
+        """One mean-all-reduce of a flat/shaped fp32 payload through
+        the configured path: two-level when the hierarchy is active,
+        flat compressed otherwise."""
+        kw = self._comm_kw()
+        if self.hierarchical:
+            return hierarchical_psum(x, self.axes, self.ici_size,
+                                     self.dcn_size, mean=True, rng=rng,
+                                     with_error=with_error, **kw)
+        return compressed_psum(x, self.axes, self.world, mean=True,
+                               rng=rng, with_error=with_error, **kw)
+
+    def _leaf_keys(self, rng, count: int):
+        keys = [None] * count
+        if self.policy.stochastic_rounding:
+            if rng is None:
+                raise ValueError("stochastic rounding needs an rng key")
+            keys = list(jax.random.split(rng, count))
+        return keys
+
     def sync(self, grads, residual, rng: Optional[jax.Array] = None):
         """Inside ``shard_map``: compressed mean-reduction of the local
-        gradient tree.  ``residual`` leaves arrive as this rank's
-        ``[1, *shape]`` slice (or ``()`` with EF off).  Returns
-        ``(synced, new_residual)`` with the residual re-stacked to
-        ``[1, *shape]`` for the sharded out-spec."""
+        gradient tree, one collective per leaf.  ``residual`` leaves
+        arrive as this rank's ``[1, *shape]`` slice (or ``()`` with EF
+        off).  Returns ``(synced, new_residual)`` with the residual
+        re-stacked to ``[1, *shape]`` for the sharded out-spec."""
         ef = self.error_feedback
         g_leaves, treedef = jax.tree_util.tree_flatten(grads)
         r_leaves = jax.tree_util.tree_leaves(residual) if ef \
             else [None] * len(g_leaves)
-        kw = self._comm_kw()
-        keys = [None] * len(g_leaves)
-        if self.policy.stochastic_rounding:
-            if rng is None:
-                raise ValueError("stochastic rounding needs an rng key")
-            keys = list(jax.random.split(rng, len(g_leaves)))
+        keys = self._leaf_keys(rng, len(g_leaves))
         synced, new_res = [], []
         for g, r, k in zip(g_leaves, r_leaves, keys):
             x = g.astype(jnp.float32)
             if ef:
                 x = x + r[0]
-            out = compressed_psum(x, self.axes, self.world, mean=True,
-                                  rng=k, with_error=ef, **kw)
+            out = self._psum(x, k, with_error=ef)
             if ef:
                 res, err = out
                 new_res.append(err[None])
@@ -333,6 +482,71 @@ class GradSync:
         residual_tree = (jax.tree_util.tree_unflatten(treedef, new_res)
                          if ef else ())
         return synced_tree, residual_tree
+
+    def sync_bucketed(self, grads, residual,
+                      rng: Optional[jax.Array] = None,
+                      barrier: Optional[bool] = None):
+        """Inside ``shard_map``: like :meth:`sync` but the gradient
+        leaves coalesce into size-targeted buckets
+        (``policy.bucket_bytes``) and each bucket syncs through ONE
+        collective whose only data dependency is its own leaves — small
+        leaves amortize collective latency, and XLA's latency-hiding
+        scheduler is free to issue a bucket's (DCN) transfer as soon as
+        its gradients exist, overlapping it with the remaining backward
+        compute instead of paying the whole sync at an end-of-backward
+        barrier.  ``barrier=True`` (bench A/B; default
+        ``policy.barrier_sync``) deliberately re-creates that barrier:
+        every bucket payload is tied to the COMPLETE gradient tree with
+        an ``optimization_barrier`` so no collective can start until
+        the full backward has finished."""
+        barrier = self.policy.barrier_sync if barrier is None else barrier
+        ef = self.error_feedback
+        g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+        r_leaves = jax.tree_util.tree_leaves(residual) if ef \
+            else [None] * len(g_leaves)
+        buckets = partition_buckets(
+            [leaf.size * 4 for leaf in g_leaves], self.policy.bucket_bytes)
+        payloads = []
+        for idxs in buckets:
+            parts = []
+            for i in idxs:
+                x = g_leaves[i].astype(jnp.float32)
+                if ef:
+                    x = x + r_leaves[i][0]
+                parts.append(x.ravel())
+            payloads.append(parts[0] if len(parts) == 1
+                            else jnp.concatenate(parts))
+        if barrier:
+            payloads = list(lax.optimization_barrier(tuple(payloads)))
+        keys = self._leaf_keys(rng, len(payloads))
+        synced = [None] * len(g_leaves)
+        new_res = [None] * len(g_leaves)
+        for idxs, payload, k in zip(buckets, payloads, keys):
+            out = self._psum(payload, k, with_error=ef)
+            res, err = (out if ef else (out, None))
+            off = 0
+            for i in idxs:
+                g = g_leaves[i]
+                piece = res[off:off + g.size].reshape(g.shape)
+                if self.plain_axes:
+                    piece = lax.pmean(piece, self.plain_axes)
+                synced[i] = piece.astype(g.dtype)
+                if ef:
+                    new_res[i] = err[off:off + g.size].reshape(
+                        g.shape)[None]
+                off += g.size
+        synced_tree = jax.tree_util.tree_unflatten(treedef, synced)
+        residual_tree = (jax.tree_util.tree_unflatten(treedef, new_res)
+                         if ef else ())
+        return synced_tree, residual_tree
+
+    def sync_step(self, grads, residual,
+                  rng: Optional[jax.Array] = None):
+        """The step builder's entry point: bucketed overlap scheduling
+        when ``policy.bucket_bytes > 0``, per-leaf sync otherwise."""
+        if self.policy.bucket_bytes > 0:
+            return self.sync_bucketed(grads, residual, rng=rng)
+        return self.sync(grads, residual, rng=rng)
 
     # -- global-view param re-gather (ZeRO-1 satellite path) -------------
 
@@ -397,6 +611,26 @@ class GradSync:
     def psum_wire_bytes(self, n_elements: int) -> int:
         return (self.reduce_scatter_wire_bytes(n_elements)
                 + self.all_gather_wire_bytes(n_elements))
+
+    def psum_link_bytes(self, n_elements: int) -> dict:
+        """Per-rank wire bytes of ONE mean-psum of ``n_elements``, split
+        by link tier — the per-link attribution the planner's cost
+        model and the ``rlt_comm_dcn_bytes_total`` series consume.
+        Flat: both compressed phases ride whatever link the axis spans
+        (charged as the slow tier; a single-host run has no DCN hop and
+        the scorer maps it to ICI speed).  Hierarchical: only the
+        level-2 phases on the 1/ici shard cross DCN; levels 1 and 3
+        move fp32 inside the ICI group."""
+        if not self.hierarchical:
+            return {"dcn": self.psum_wire_bytes(n_elements), "ici": 0}
+        shard = -(-n_elements // self.ici_size)
+        dcn = 2 * payload_bytes(shard, self.policy.compress,
+                                self.policy.block_size)
+        # level 1 all_to_all moves the full fp32 rows, level 3
+        # all-gathers the fp32 result back: ~8 bytes/element on the
+        # fast link (the EQuARX trade: fp32 where bandwidth is cheap)
+        ici = 4 * n_elements + 4 * shard * self.ici_size
+        return {"dcn": dcn, "ici": ici}
 
     def param_gather_wire_bytes(self, abstract_params) -> int:
         total = 0

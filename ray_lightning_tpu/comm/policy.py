@@ -14,8 +14,10 @@ CompileCacheConfig):
 - ``Trainer(comm_policy="int8")`` — compress with defaults;
 - ``Trainer(comm_policy={...})`` — kwargs dict;
 - ``RLT_COMM=int8`` (+ ``RLT_COMM_AXES=data``, ``RLT_COMM_BLOCK=64``,
-  ``RLT_COMM_SR=1``, ``RLT_COMM_EF=0``, ``RLT_COMM_PARAM_GATHER=bf16``)
-  — env knobs, read when the Trainer arg is ``None``.
+  ``RLT_COMM_SR=1``, ``RLT_COMM_EF=0``, ``RLT_COMM_PARAM_GATHER=bf16``,
+  ``RLT_COMM_HIER=auto|K``, ``RLT_COMM_BUCKET_BYTES=N``,
+  ``RLT_COMM_BARRIER=1``) — env knobs, read when the Trainer arg is
+  ``None``.
 
 The resolved policy is a frozen dataclass that pickles with the trainer
 driver→worker; the env knobs additionally round-trip through
@@ -29,8 +31,12 @@ import dataclasses
 import os
 from typing import Optional
 
-VALID_COMPRESS = ("none", "int8", "bf16")
+VALID_COMPRESS = ("none", "int8", "bf16", "fp8", "int4")
 VALID_PARAM_GATHER = ("none", "bf16", "int8")
+
+#: ``hierarchy`` sentinel: size the ICI tier from the runtime's
+#: ``jax.local_device_count()`` (chips sharing this host's fast link)
+HIER_AUTO = -1
 
 
 def _env_flag(name: str, default: bool) -> bool:
@@ -48,12 +54,15 @@ class CommPolicy:
 
     compress: payload dtype of the gradient reduction over the selected
         axes — ``"int8"`` (blockwise scales, ~4x fewer bytes),
-        ``"bf16"`` (plain cast, 2x), ``"none"`` (off; the default —
-        bit-identical to the uncompressed build).
+        ``"fp8"`` (e4m3, same bytes as int8, relative error bound),
+        ``"int4"`` (nibble-packed, ~8x), ``"bf16"`` (plain cast, 2x),
+        ``"none"`` (off; the default — bit-identical to the
+        uncompressed build).
     axes: mesh axes whose reduction compresses.  ``None`` = auto:
         the strategy's data axes when the run spans processes (the
         DCN case), nothing on a single process (all-ICI stays fp32).
-    block_size: int8 scale-block length.
+    block_size: scale-block length (int8/fp8/int4; must be even for
+        int4's pair packing).
     stochastic_rounding: unbiased quantizer (one uniform per element).
     error_feedback: carry the per-rank quantization error in optimizer
         state and re-inject it next step (parity-critical; on by
@@ -62,6 +71,27 @@ class CommPolicy:
         ``"none"`` keeps it at the parameter dtype (no quality risk),
         ``"bf16"``/``"int8"`` compress it too (no error feedback exists
         on the parameter path, so this is the aggressive opt-in).
+    hierarchy: two-level reduction (the EQuARX split): ``0`` = off
+        (flat — today's behavior), ``HIER_AUTO``/-1 = size the fast
+        tier from ``jax.local_device_count()``, ``K >= 2`` = explicit
+        ICI group size.  When active (1 < K < world, K divides world)
+        the gradient reduction sums fp32 within each K-rank ICI group
+        first and only the cross-group (DCN) hop carries the codec —
+        inter-host bytes shrink by ANOTHER factor K on top of the
+        codec's, and error feedback absorbs strictly less noise (one
+        quantization of a 1/K shard instead of the full payload).
+    bucket_bytes: ``0`` = sync each gradient leaf separately (today's
+        behavior); ``> 0`` = coalesce leaves into size-targeted buckets
+        and issue one collective per bucket, each depending only on its
+        own leaves — fewer dispatches for small leaves AND the dataflow
+        freedom XLA's latency-hiding scheduler needs to overlap a
+        bucket's DCN transfer with the rest of the backward pass
+        (the TorchTitan bucketed-sync construction).
+    barrier_sync: bench A/B knob: tie every bucket's payload to the
+        COMPLETE gradient tree with an ``optimization_barrier`` before
+        any collective is issued — the single end-of-backward barrier
+        the bucketed path exists to beat.  Only meaningful with
+        ``bucket_bytes > 0``; never enable outside measurements.
     """
 
     compress: str = "none"
@@ -70,6 +100,9 @@ class CommPolicy:
     stochastic_rounding: bool = False
     error_feedback: bool = True
     param_gather: str = "none"
+    hierarchy: int = 0
+    bucket_bytes: int = 0
+    barrier_sync: bool = False
 
     def __post_init__(self):
         if self.compress not in VALID_COMPRESS:
@@ -82,6 +115,16 @@ class CommPolicy:
                 f"options: {VALID_PARAM_GATHER}")
         if self.block_size <= 0:
             raise ValueError("comm_policy block_size must be positive")
+        if self.compress == "int4" and self.block_size % 2:
+            raise ValueError("comm_policy int4 needs an even block_size "
+                             "(two values pack per byte)")
+        if self.hierarchy < HIER_AUTO or self.hierarchy == 1:
+            raise ValueError(
+                f"comm_policy hierarchy {self.hierarchy!r}: 0 (flat), "
+                f"{HIER_AUTO} (auto: local device count) or an ICI "
+                f"group size >= 2")
+        if self.bucket_bytes < 0:
+            raise ValueError("comm_policy bucket_bytes must be >= 0")
         if self.axes is not None:
             object.__setattr__(self, "axes", tuple(self.axes))
 
@@ -100,6 +143,8 @@ class CommPolicy:
         compress = os.environ.get("RLT_COMM", "none").strip() or "none"
         axes_raw = os.environ.get("RLT_COMM_AXES", "").strip()
         axes = tuple(a for a in axes_raw.split(",") if a) or None
+        hier_raw = os.environ.get("RLT_COMM_HIER", "0").strip() or "0"
+        hierarchy = HIER_AUTO if hier_raw == "auto" else int(hier_raw)
         return cls(
             compress=compress,
             axes=axes,
@@ -108,6 +153,9 @@ class CommPolicy:
             error_feedback=_env_flag("RLT_COMM_EF", True),
             param_gather=os.environ.get(
                 "RLT_COMM_PARAM_GATHER", "none").strip() or "none",
+            hierarchy=hierarchy,
+            bucket_bytes=int(os.environ.get("RLT_COMM_BUCKET_BYTES", "0")),
+            barrier_sync=_env_flag("RLT_COMM_BARRIER", False),
         )
 
     # -- queries ---------------------------------------------------------
@@ -133,6 +181,22 @@ class CommPolicy:
                      if a in data_axis_names and a in mesh.axis_names
                      and mesh.shape[a] > 1)
 
+    def resolved_hierarchy(self, world: int) -> "tuple[int, int]":
+        """``(ici_size, dcn_size)`` of the two-level reduction over a
+        ``world``-rank axis product: ``(1, world)`` = flat (hierarchy
+        off, invalid, or degenerate — the whole axis on one tier).
+        ``HIER_AUTO`` sizes the ICI tier from the runtime's local
+        device count; the contiguous-block rank layout this implies
+        (rank = host * local + local_index) is exactly how the mesh
+        builder orders ``jax.devices()`` (process-major)."""
+        h = self.hierarchy
+        if h == HIER_AUTO:
+            import jax
+            h = jax.local_device_count()
+        if h <= 1 or h >= world or world % h:
+            return (1, world)
+        return (h, world // h)
+
     # -- env round-trip --------------------------------------------------
 
     def worker_env(self) -> dict:
@@ -147,6 +211,10 @@ class CommPolicy:
             "RLT_COMM_SR": "1" if self.stochastic_rounding else "0",
             "RLT_COMM_EF": "1" if self.error_feedback else "0",
             "RLT_COMM_PARAM_GATHER": self.param_gather,
+            "RLT_COMM_HIER": ("auto" if self.hierarchy == HIER_AUTO
+                              else str(self.hierarchy)),
+            "RLT_COMM_BUCKET_BYTES": str(self.bucket_bytes),
+            "RLT_COMM_BARRIER": "1" if self.barrier_sync else "0",
         }
         if self.axes is not None:
             env["RLT_COMM_AXES"] = ",".join(self.axes)

@@ -14,6 +14,22 @@ rounding (``floor(x/scale + u)``, u ~ U[0,1)) makes the quantizer
 unbiased at the cost of one uniform draw per element — the EQuARX
 recommendation for repeated-accumulation settings.
 
+fp8 scheme (e4m3): per-block scaling to the e4m3fn range (max 448),
+then a cast to ``float8_e4m3fn``.  Same byte count as int8 but a
+*relative* error bound (~2^-4 per element at 3 mantissa bits) instead
+of int8's absolute-within-block one — outlier-heavy blocks keep their
+small elements.  The wire payload is bitcast to ``uint8`` so every
+backend moves exactly one byte per element (XLA CPU would otherwise
+widen an f8 collective to f16).  Stochastic rounding picks between the
+two neighboring e4m3 grid points with probability proportional to the
+distance — exactly unbiased, like the int path.
+
+int4 scheme: per-block scales ``max|x| / 7``, values in [-7, 7] stored
+offset-encoded (q+8) two to a byte — the wire payload's last dim is
+HALF the element count.  The most aggressive codec; intended for the
+DCN hop of a hierarchical reduction where error feedback absorbs the
+coarser grid.
+
 bf16 scheme: a plain cast (no scales).  Half the bytes of fp32, exact
 for the ~8 mantissa bits kept; used when int8's 4x is too aggressive for
 a workload.
@@ -25,6 +41,13 @@ import jax
 import jax.numpy as jnp
 
 INT8_LEVELS = 127.0
+INT4_LEVELS = 7.0
+FP8_MAX = 448.0          # float8_e4m3fn max finite value
+FP8_MANT_BITS = 3
+FP8_MIN_EXP = -6         # smallest normal exponent of e4m3
+
+#: every mode ``compress_cast`` accepts (policy.py validates against it)
+CODEC_MODES = ("int8", "bf16", "fp8", "int4")
 
 
 def _block_view(x: jax.Array, block_size: int) -> jax.Array:
@@ -33,6 +56,23 @@ def _block_view(x: jax.Array, block_size: int) -> jax.Array:
         raise ValueError(
             f"last dim {x.shape[-1]} not a multiple of block {block_size}")
     return x.reshape(x.shape[:-1] + (x.shape[-1] // block_size, block_size))
+
+
+def _block_scale(blocks: jax.Array, levels: float):
+    """(scale, inv_scale) per block; zero blocks get zero for both."""
+    scale = jnp.max(jnp.abs(blocks), axis=-1) / levels
+    inv = jnp.where(scale > 0, 1.0 / jnp.where(scale > 0, scale, 1.0), 0.0)
+    return scale, inv
+
+
+def _int_round(val: jax.Array, levels: float, *, stochastic: bool, rng):
+    if stochastic:
+        if rng is None:
+            raise ValueError("stochastic rounding needs an rng key")
+        val = jnp.floor(val + jax.random.uniform(rng, val.shape))
+    else:
+        val = jnp.round(val)
+    return jnp.clip(val, -levels, levels)
 
 
 def blockwise_quantize(x: jax.Array, block_size: int = 64, *,
@@ -44,16 +84,9 @@ def blockwise_quantize(x: jax.Array, block_size: int = 64, *,
     shaped ``[..., n_blocks]`` (one per block of the last dim).
     """
     blocks = _block_view(x.astype(jnp.float32), block_size)
-    scale = jnp.max(jnp.abs(blocks), axis=-1) / INT8_LEVELS
-    inv = jnp.where(scale > 0, 1.0 / jnp.where(scale > 0, scale, 1.0), 0.0)
-    val = blocks * inv[..., None]
-    if stochastic:
-        if rng is None:
-            raise ValueError("stochastic rounding needs an rng key")
-        val = jnp.floor(val + jax.random.uniform(rng, val.shape))
-    else:
-        val = jnp.round(val)
-    q = jnp.clip(val, -INT8_LEVELS, INT8_LEVELS).astype(jnp.int8)
+    scale, inv = _block_scale(blocks, INT8_LEVELS)
+    q = _int_round(blocks * inv[..., None], INT8_LEVELS,
+                   stochastic=stochastic, rng=rng).astype(jnp.int8)
     return q.reshape(x.shape), scale
 
 
@@ -64,17 +97,109 @@ def blockwise_dequantize(q: jax.Array, scale: jax.Array,
     return (blocks * scale[..., None]).reshape(q.shape)
 
 
+# -- fp8 (e4m3) -------------------------------------------------------------
+
+
+def _fp8_stochastic_round(v: jax.Array, rng) -> jax.Array:
+    """Exact stochastic rounding onto the e4m3 grid: pick the lower /
+    upper neighboring representable value with probability proportional
+    to the fractional distance (E[result] == v).  ``v`` must already be
+    scaled into [-FP8_MAX, FP8_MAX]; the result is exactly
+    representable, so the following round-to-nearest cast is lossless.
+    """
+    if rng is None:
+        raise ValueError("stochastic rounding needs an rng key")
+    a = jnp.abs(v)
+    e = jnp.floor(jnp.log2(jnp.where(a > 0, a, 1.0)))
+    e = jnp.clip(e, FP8_MIN_EXP, 8)           # subnormals share 2^-6's ulp
+    ulp = jnp.exp2(e - FP8_MANT_BITS)
+    lower = jnp.floor(a / ulp) * ulp
+    frac = (a - lower) / ulp
+    u = jax.random.uniform(rng, v.shape)
+    a_sr = jnp.minimum(lower + jnp.where(u < frac, ulp, 0.0), FP8_MAX)
+    return jnp.sign(v) * a_sr
+
+
+def fp8_blockwise_quantize(x: jax.Array, block_size: int = 64, *,
+                           stochastic: bool = False,
+                           rng: "jax.Array | None" = None):
+    """Quantize to e4m3 with per-block range scaling.  Returns
+    ``(payload, scale)`` with ``payload`` the f8 bit pattern as uint8
+    (shaped like ``x``) — one byte per element on every backend."""
+    blocks = _block_view(x.astype(jnp.float32), block_size)
+    scale_range, inv = _block_scale(blocks, FP8_MAX)
+    val = blocks * inv[..., None]
+    if stochastic:
+        val = _fp8_stochastic_round(val, rng)
+    q8 = val.astype(jnp.float8_e4m3fn)        # RN cast; |val| <= 448 so
+    #                                           it can never overflow
+    payload = jax.lax.bitcast_convert_type(q8, jnp.uint8)
+    return payload.reshape(x.shape), scale_range
+
+
+def fp8_blockwise_dequantize(payload: jax.Array, scale: jax.Array,
+                             block_size: int = 64) -> jax.Array:
+    q8 = jax.lax.bitcast_convert_type(payload, jnp.float8_e4m3fn)
+    blocks = _block_view(q8.astype(jnp.float32), block_size)
+    return (blocks * scale[..., None]).reshape(payload.shape)
+
+
+# -- int4 (nibble-packed) ---------------------------------------------------
+
+
+def int4_blockwise_quantize(x: jax.Array, block_size: int = 64, *,
+                            stochastic: bool = False,
+                            rng: "jax.Array | None" = None):
+    """Quantize to 4-bit levels [-7, 7] with per-block scales, packing
+    two values per byte.  Returns ``(payload, scale)`` with ``payload``
+    uint8 shaped ``[..., n // 2]`` — the only codec whose wire shape
+    differs from the input's.  ``block_size`` must be even."""
+    if block_size % 2:
+        raise ValueError(f"int4 needs an even block size, got {block_size}")
+    blocks = _block_view(x.astype(jnp.float32), block_size)
+    scale, inv = _block_scale(blocks, INT4_LEVELS)
+    q = _int_round(blocks * inv[..., None], INT4_LEVELS,
+                   stochastic=stochastic, rng=rng)
+    # offset-encode to [1, 15] and pack adjacent pairs into one byte
+    q = (q + 8.0).astype(jnp.uint8).reshape(x.shape)
+    pairs = q.reshape(x.shape[:-1] + (x.shape[-1] // 2, 2))
+    packed = pairs[..., 0] | (pairs[..., 1] << 4)
+    return packed, scale
+
+
+def int4_blockwise_dequantize(payload: jax.Array, scale: jax.Array,
+                              block_size: int = 64) -> jax.Array:
+    lo = (payload & 0xF).astype(jnp.float32) - 8.0
+    hi = (payload >> 4).astype(jnp.float32) - 8.0
+    full = jnp.stack([lo, hi], axis=-1).reshape(
+        payload.shape[:-1] + (payload.shape[-1] * 2,))
+    blocks = _block_view(full, block_size)
+    return (blocks * scale[..., None]).reshape(full.shape)
+
+
+# -- uniform codec dispatch -------------------------------------------------
+
+
 def compress_cast(x: jax.Array, mode: str, block_size: int = 64, *,
                   stochastic: bool = False,
                   rng: "jax.Array | None" = None):
-    """Uniform (q, scale) encode for either mode: int8 returns blockwise
-    payload + scales, bf16 returns the cast payload with ``scale=None``."""
+    """Uniform ``(payload, scale)`` encode for every codec: int8/fp8
+    return a 1-byte payload shaped like ``x`` plus per-block scales,
+    int4 a half-length packed payload, bf16 the cast with
+    ``scale=None``."""
     if mode == "bf16":
         return x.astype(jnp.bfloat16), None
     if mode == "int8":
         return blockwise_quantize(x, block_size, stochastic=stochastic,
                                   rng=rng)
-    raise ValueError(f"unknown compression mode {mode!r}")
+    if mode == "fp8":
+        return fp8_blockwise_quantize(x, block_size, stochastic=stochastic,
+                                      rng=rng)
+    if mode == "int4":
+        return int4_blockwise_quantize(x, block_size, stochastic=stochastic,
+                                       rng=rng)
+    raise ValueError(f"unknown compression mode {mode!r}; "
+                     f"options: {CODEC_MODES}")
 
 
 def decompress_cast(q: jax.Array, scale, mode: str,
@@ -82,17 +207,27 @@ def decompress_cast(q: jax.Array, scale, mode: str,
     """fp32 decode matching :func:`compress_cast`."""
     if mode == "bf16":
         return q.astype(jnp.float32)
-    return blockwise_dequantize(q, scale, block_size)
+    if mode == "int8":
+        return blockwise_dequantize(q, scale, block_size)
+    if mode == "fp8":
+        return fp8_blockwise_dequantize(q, scale, block_size)
+    if mode == "int4":
+        return int4_blockwise_dequantize(q, scale, block_size)
+    raise ValueError(f"unknown compression mode {mode!r}; "
+                     f"options: {CODEC_MODES}")
 
 
 def payload_bytes(n_elements: int, mode: str, block_size: int = 64) -> int:
     """Wire bytes one rank's ``n_elements`` payload occupies compressed
-    (int8 data + fp32 per-block scales; bf16 has no scales).  Used by the
-    strategies' ``step_collective_bytes`` so the metrics plane charges
-    the *compressed* traffic."""
+    (1-byte data + fp32 per-block scales for int8/fp8; int4 packs two
+    elements per byte; bf16 has no scales).  Used by the strategies'
+    ``step_collective_bytes`` so the metrics plane charges the
+    *compressed* traffic."""
     if mode == "bf16":
         return 2 * n_elements
-    if mode == "int8":
-        n_blocks = -(-n_elements // block_size)
+    n_blocks = -(-n_elements // block_size)
+    if mode == "int8" or mode == "fp8":
         return n_elements + 4 * n_blocks
+    if mode == "int4":
+        return -(-n_elements // 2) + 4 * n_blocks
     return 4 * n_elements

@@ -7,11 +7,17 @@ full training run:
 - policy resolution on every built-in strategy: DDP / ZeRO-1 resolve to
   a GradSync on a multi-device data mesh, FSDP / SPMD / pipeline
   decline (params sharded), and the off policy is inert everywhere;
-- the RLT_COMM* env knobs round-trip through ``worker_env()`` →
-  ``resolve()`` unchanged;
-- the compressed collectives LOWER without error on a CPU mesh (both
-  int8 and bf16, via the shard_map compat wrapper) and the quantizer
-  round-trips exactly-representable payloads bit-exactly.
+- the RLT_COMM* env knobs (codec, hierarchy, buckets included)
+  round-trip through ``worker_env()`` → ``resolve()`` unchanged;
+- the compressed collectives LOWER without error on a CPU mesh (every
+  codec: int8 / bf16 / fp8 / int4, via the shard_map compat wrapper),
+  the two-level hierarchical psum lowers with its grouped collectives,
+  and the quantizer round-trips exactly-representable payloads
+  bit-exactly;
+- the bucket partitioner covers every leaf exactly once, in order;
+- the comm metric names (rlt_comm_dcn_bytes_total,
+  rlt_comm_exposed_seconds) are registered in the lint's CORE_METRICS
+  surface.
 """
 
 from __future__ import annotations
@@ -62,10 +68,11 @@ def _main(argv) -> int:   # noqa: ARG001 - argv kept for parity
     if build_grad_sync(pstrat, pstrat.build_mesh(), policy) is not None:
         problems.append("pipeline strategy should decline compression")
 
-    # 2. env knob round-trip
-    src = CommPolicy(compress="bf16", axes=("data",), block_size=128,
+    # 2. env knob round-trip (hierarchy/bucket/barrier knobs included)
+    src = CommPolicy(compress="fp8", axes=("data",), block_size=128,
                      stochastic_rounding=True, error_feedback=False,
-                     param_gather="bf16")
+                     param_gather="bf16", hierarchy=2,
+                     bucket_bytes=1 << 20, barrier_sync=True)
     saved = {k: os.environ.get(k) for k in src.worker_env()}
     os.environ.update(src.worker_env())
     try:
@@ -78,13 +85,16 @@ def _main(argv) -> int:   # noqa: ARG001 - argv kept for parity
             else:
                 os.environ[k] = v
 
-    # 3. compressed collectives lower on the CPU mesh; quantizer exact
-    #    on exactly-representable payloads
+    # 3. compressed collectives lower on the CPU mesh (every codec,
+    #    flat AND two-level); quantizer exact on representable payloads
     from jax.sharding import PartitionSpec as P
+
+    from ray_lightning_tpu.comm.collectives import (hierarchical_psum,
+                                                    partition_buckets)
     strat = resolve_strategy("ddp")
     mesh = strat.build_mesh()
     world = mesh.shape["data"]
-    for mode in ("int8", "bf16"):
+    for mode in ("int8", "bf16", "fp8", "int4"):
         def body(x, mode=mode):
             return compressed_psum(x[0], "data", world, mode=mode,
                                    mean=True)[None]
@@ -96,6 +106,39 @@ def _main(argv) -> int:   # noqa: ARG001 - argv kept for parity
         except Exception as e:   # noqa: BLE001 - report, don't crash
             problems.append(f"compressed psum ({mode}) failed to lower "
                             f"on the CPU mesh: {e!r}")
+
+    def hier_body(x):
+        return hierarchical_psum(x[0], "data", 2, world // 2,
+                                 mode="int8", mean=True)[None]
+    try:
+        fn = shard_map_compat(hier_body, mesh, in_specs=P("data"),
+                              out_specs=P("data"))
+        jax.jit(fn).lower(
+            jax.ShapeDtypeStruct((world, 300), np.float32)).compile()
+    except Exception as e:   # noqa: BLE001
+        problems.append(f"hierarchical psum failed to lower on the CPU "
+                        f"mesh: {e!r}")
+
+    # 3b. bucket partitioner invariant: every index exactly once, in
+    # order, and the target is respected (oversized leaves go alone)
+    for sizes, target in (([100, 200, 4000, 50, 50], 300),
+                          ([8] * 7, 16), ([1], 0)):
+        buckets = partition_buckets(sizes, target)
+        flat = [i for b in buckets for i in b]
+        if flat != list(range(len(sizes))):
+            problems.append(
+                f"bucket partition {buckets} of {sizes} does not cover "
+                f"every leaf exactly once in order")
+        if target > 0 and any(sum(sizes[i] for i in b) < target
+                              for b in buckets[:-1]):
+            problems.append(f"bucket partition {buckets} closed a "
+                            f"bucket under target {target}")
+
+    # 3c. comm metric names are on the lint surface
+    from ray_lightning_tpu.telemetry.metrics import CORE_METRICS
+    for name in ("rlt_comm_dcn_bytes_total", "rlt_comm_exposed_seconds"):
+        if name not in CORE_METRICS:
+            problems.append(f"{name} missing from telemetry CORE_METRICS")
     # two blocks whose max-abs is exactly 127 -> scale 1.0 -> integer
     # payloads must round-trip bit-exactly
     x = np.concatenate([np.arange(-127, 1), np.arange(0, 128)]) \
@@ -107,8 +150,9 @@ def _main(argv) -> int:   # noqa: ARG001 - argv kept for parity
     for p in problems:
         print(f"comm selfcheck: {p}")
     if not problems:
-        print("comm selfcheck: policy resolution, env round-trip, and "
-              "CPU-mesh lowering OK")
+        print("comm selfcheck: policy resolution, env round-trip, codec "
+              "+ hierarchical CPU-mesh lowering, bucket partition, and "
+              "metric names OK")
     return 1 if problems else 0
 
 

@@ -140,6 +140,10 @@ class ScoredCompile:
     temp_bytes: int = 0
     alias_bytes: int = 0
     wire_bytes: int = 0
+    #: per-link split of wire_bytes (replica-group classification with
+    #: the candidate's ici group size) — both 0 for flat candidates
+    wire_bytes_dcn: int = 0
+    wire_bytes_ici: int = 0
     error: Optional[str] = None
 
     @property
@@ -163,6 +167,8 @@ class ScoredCompile:
             "alias_bytes": self.alias_bytes,
             "peak_bytes": self.peak_bytes,
             "wire_bytes": self.wire_bytes,
+            "wire_bytes_dcn": self.wire_bytes_dcn,
+            "wire_bytes_ici": self.wire_bytes_ici,
             "error": self.error,
         }
 
@@ -172,8 +178,11 @@ def compile_scored(programs: "list[tuple[str, Any, tuple, int]]",
     """AOT-compile candidate programs concurrently and score each.
 
     ``programs`` entries are ``(name, jitted, abstract_args,
-    axis_size)`` — ``axis_size`` scales reduce-scatter results back to
-    input bytes in the wire audit.  Unlike :class:`AotPrecompiler`
+    axis_size)`` or ``(..., axis_size, ici_size)`` — ``axis_size``
+    scales reduce-scatter results back to input bytes in the wire
+    audit; a non-zero ``ici_size`` (hierarchical comm candidates)
+    additionally splits the audited bytes by link tier over each
+    collective's replica groups.  Unlike :class:`AotPrecompiler`
     (one thread — its compiles overlap the main thread's init compile),
     these run BEFORE any other compilation exists, so a small pool is
     pure win; with the persistent cache active every artifact lands on
@@ -183,10 +192,12 @@ def compile_scored(programs: "list[tuple[str, Any, tuple, int]]",
     """
     import concurrent.futures
 
-    from ray_lightning_tpu.comm.audit import total_wire_bytes
+    from ray_lightning_tpu.comm.audit import (total_wire_bytes,
+                                              wire_bytes_by_link)
 
     def one(entry) -> ScoredCompile:
-        name, jitted, args, axis_size = entry
+        name, jitted, args, axis_size = entry[:4]
+        ici_size = entry[4] if len(entry) > 4 else 0
         t0 = time.monotonic()
         try:
             compiled = jitted.lower(*args).compile()
@@ -209,8 +220,13 @@ def compile_scored(programs: "list[tuple[str, Any, tuple, int]]",
             _log.debug("memory_analysis unavailable for %s", name,
                        exc_info=True)
         try:
-            out.wire_bytes = total_wire_bytes(compiled.as_text(),
-                                              axis_size=axis_size)
+            text = compiled.as_text()
+            out.wire_bytes = total_wire_bytes(text, axis_size=axis_size)
+            if ici_size > 1:
+                link = wire_bytes_by_link(text, ici_size,
+                                          axis_size=axis_size)
+                out.wire_bytes_dcn = link["dcn"]
+                out.wire_bytes_ici = link["ici"]
         except Exception:   # noqa: BLE001 - text dump unavailable
             _log.debug("HLO wire audit unavailable for %s", name,
                        exc_info=True)

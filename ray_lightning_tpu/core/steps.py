@@ -66,9 +66,13 @@ def build_train_step(module, tx,
     replicated, batch sharded on the data axes), local grads reduce via
     quantized reduce-scatter + all-gather with the error-feedback
     residual carried in the optimizer state, and the tiny scalars
-    (loss / logged / float model-state) pmean at fp32.  With ``None``
-    the step is byte-identical to the pre-comm-plane build: gradient
-    sync stays the partitioner's implicit fp32 all-reduce.
+    (loss / logged / float model-state) pmean at fp32.  The policy can
+    further split the reduction across link tiers (``hierarchy`` —
+    fp32 inside the ICI group, codec only across DCN) and coalesce
+    leaves into overlap-schedulable buckets (``bucket_bytes`` —
+    ``GradSync.sync_step`` routes).  With ``None`` the step is
+    byte-identical to the pre-comm-plane build: gradient sync stays
+    the partitioner's implicit fp32 all-reduce.
     """
 
     def grads_of(params, model_state, rng, batch):
@@ -150,8 +154,8 @@ def build_train_step(module, tx,
             if comm_key is not None:
                 comm_key = jax.random.fold_in(comm_key,
                                               grad_sync.axis_index())
-            grads, new_residual = grad_sync.sync(grads, residual,
-                                                 rng=comm_key)
+            grads, new_residual = grad_sync.sync_step(grads, residual,
+                                                      rng=comm_key)
             loss, logged, new_ms = grad_sync.pmean((loss, logged, new_ms))
             return loss, new_ms, logged, grads, new_residual
 

@@ -425,8 +425,13 @@ class Trainer:
             # plane can charge it per executed step.  An active comm
             # plane shrinks the declared bytes to the compressed wire
             # payload, so rlt_collective_* and bench JSON see the savings
-            _metrics.note_step_collectives(strategy.step_collective_bytes(
-                self._mesh, self._abstract_state, comm=self._grad_sync))
+            from ray_lightning_tpu.comm.audit import declared_dcn_bytes
+            op_bytes = strategy.step_collective_bytes(
+                self._mesh, self._abstract_state, comm=self._grad_sync)
+            _metrics.note_step_collectives(
+                op_bytes,
+                dcn_bytes=declared_dcn_bytes(op_bytes,
+                                             jax.process_count() > 1))
         with span("init"):
             self._init_state(module, example_batch, strategy, ckpt_path)
 
@@ -699,10 +704,11 @@ class Trainer:
         if self._grad_sync is not None:
             _log.info("comm plane active: compressed gradient "
                       "collectives %s (error_feedback=%s, "
-                      "param_gather=%s)",
+                      "param_gather=%s, bucket_bytes=%d)",
                       self._grad_sync.describe(),
                       self._grad_sync.error_feedback,
-                      self.comm_policy.param_gather)
+                      self.comm_policy.param_gather,
+                      self.comm_policy.bucket_bytes)
         self._tx = self._configure_tx(module, self._grad_sync)
         self._init_fn = build_init_fn(module, self._tx)
         rng = jax.random.PRNGKey(

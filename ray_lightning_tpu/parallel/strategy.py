@@ -246,12 +246,20 @@ class ShardingStrategy:
         site).  Pure DDP: one gradient all-reduce the size of the
         params.  With an active comm plane (``comm`` = the resolved
         GradSync) the charge is the COMPRESSED wire payload, so
-        ``rlt_collective_*`` and the bench JSON reflect the savings."""
+        ``rlt_collective_*`` and the bench JSON reflect the savings; a
+        hierarchical sync splits the declaration by link tier
+        (``_dcn``/``_ici`` op suffixes — the planner scores each at its
+        own bandwidth and the metrics plane feeds
+        ``rlt_comm_dcn_bytes_total`` from the suffix)."""
         if self.data_parallel_size(mesh) <= 1:
             return {}
         if comm is not None:
-            return {"grad_all_reduce": comm.psum_wire_bytes(
-                self._tree_elements(abstract_state.params))}
+            n = self._tree_elements(abstract_state.params)
+            if comm.hierarchical:
+                link = comm.psum_link_bytes(n)
+                return {"grad_all_reduce_dcn": link["dcn"],
+                        "grad_all_reduce_ici": link["ici"]}
+            return {"grad_all_reduce": comm.psum_wire_bytes(n)}
         return {"grad_all_reduce": self._tree_bytes(abstract_state.params)}
 
     # Strategies are part of the plugin config pickled driver→worker; they
@@ -316,11 +324,21 @@ class Zero1Strategy(ShardingStrategy):
         or as all-reduce + slice, the bytes on the wire are the OSS
         story — see class docstring).  With an active comm plane the
         grad phases carry the compressed payload (+ their all-gather
-        leg) and the param gather charges at its policy dtype."""
+        leg) and the param gather charges at its policy dtype; a
+        hierarchical sync declares the grad phases per link tier
+        (``_dcn``/``_ici`` suffixes, see the base class)."""
         if self.data_parallel_size(mesh) <= 1:
             return {}
         if comm is not None:
             n = self._tree_elements(abstract_state.params)
+            if comm.hierarchical:
+                link = comm.psum_link_bytes(n)
+                return {
+                    "grad_sync_dcn": link["dcn"],
+                    "grad_sync_ici": link["ici"],
+                    "param_all_gather": comm.param_gather_wire_bytes(
+                        abstract_state.params),
+                }
             return {
                 "grad_reduce_scatter": comm.reduce_scatter_wire_bytes(n),
                 "grad_all_gather": comm.all_gather_wire_bytes(n),

@@ -71,14 +71,21 @@ def policy_for_candidate(candidate: Candidate, base_policy=None):
     """The :class:`CommPolicy` a comm-on candidate runs under: the
     user's own policy when one is active (the planner then decides
     WHETHER to apply it, not how), else the default aggressive setting
-    — int8 on the data axis, the EQuARX-style DCN compression the comm
-    plane was built for.  ``None`` for comm-off candidates."""
+    — int8 on the data axis with the two-level hierarchy armed
+    (``HIER_AUTO``: fp32 inside each host's ICI group, codec only
+    across DCN — inert when one host holds the whole axis), the
+    EQuARX-style DCN compression the comm plane was built for.  The
+    hierarchical declaration splits bytes by link tier, which is what
+    lets plan/cost.py score these candidates at per-link bandwidths
+    instead of mis-charging the fp32 ICI phases at DCN speed.
+    ``None`` for comm-off candidates."""
     if not candidate.comm:
         return None
     from ray_lightning_tpu.comm import CommPolicy
+    from ray_lightning_tpu.comm.policy import HIER_AUTO
     if base_policy is not None and base_policy.enabled:
         return base_policy
-    return CommPolicy(compress="int8", axes=("data",))
+    return CommPolicy(compress="int8", axes=("data",), hierarchy=HIER_AUTO)
 
 
 def enumerate_candidates(

@@ -11,6 +11,10 @@ pattern (first match wins):
   ``RLT_PLAN_STRATEGIES`` / ``RLT_PLAN_MICROBATCH`` /
   ``RLT_PLAN_HBM_BYTES`` / ``RLT_PLAN_HEADROOM`` — env knobs, read when
   the Trainer arg is ``None``.
+- ``RLT_PLAN_CALIBRATE=1`` — replace the bandwidth constants with
+  MEASURED link speeds (comm/calibrate.py: a tiny collective
+  microbench, run once and cached per topology fingerprint).  Explicit
+  ``RLT_PLAN_{ICI,DCN}_GBPS`` values still win.
 
 The resolved config pickles driver→worker on the Trainer and
 round-trips through ``worker_env()`` like the comm/compile/elastic
@@ -39,8 +43,9 @@ ENV_STRATEGIES = "RLT_PLAN_STRATEGIES"
 ENV_MICROBATCH = "RLT_PLAN_MICROBATCH"
 ENV_HBM = "RLT_PLAN_HBM_BYTES"
 ENV_HEADROOM = "RLT_PLAN_HEADROOM"
+ENV_CALIBRATE = "RLT_PLAN_CALIBRATE"
 ENV_KNOBS = (ENV_TOPK, ENV_ICI, ENV_DCN, ENV_STRATEGIES, ENV_MICROBATCH,
-             ENV_HBM, ENV_HEADROOM)
+             ENV_HBM, ENV_HEADROOM, ENV_CALIBRATE)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -122,6 +127,12 @@ class PlanConfig:
         raw = os.environ.get(ENV_TOPK, "").strip()
         if raw:
             kw["topk"] = int(raw)
+        if os.environ.get(ENV_CALIBRATE, "").strip() in ("1", "true",
+                                                         "True"):
+            # measured link bandwidths (cached per topology) replace
+            # the constants; explicit RLT_PLAN_*_GBPS still win below
+            from ray_lightning_tpu.comm.calibrate import calibrated_gbps
+            kw["ici_gbps"], kw["dcn_gbps"] = calibrated_gbps()
         raw = os.environ.get(ENV_ICI, "").strip()
         if raw:
             kw["ici_gbps"] = float(raw)

@@ -6,9 +6,14 @@ Two estimates per candidate, both computed WITHOUT compiling anything:
   declaration (``step_collective_bytes`` — the same numbers the metrics
   plane charges, pinned against audited HLO wire bytes by
   tests/test_plan.py's drift guard) converted through the per-link
-  bandwidth model (comm/audit.py ``bytes_to_seconds``; DCN when the run
-  spans processes — the mesh construction puts the data axis across
-  hosts — ICI otherwise).
+  bandwidth model (comm/audit.py ``bytes_to_seconds``): each op is
+  scored at ITS link's bandwidth — ``_ici``-suffixed ops (the fp32
+  intra-host phases of a hierarchical sync) always ride ICI, everything
+  else rides DCN when the run spans processes (the mesh construction
+  puts the data axis across hosts) and ICI otherwise.  Without the
+  split, a hierarchical candidate's 8-bytes/element ICI phases would be
+  charged at DCN speed and the planner would mis-rank it below the flat
+  codec it strictly beats on the slow link.
 - **HBM peak**: the sharded TrainState residency from ``eval_shape``
   avals + the strategy's shardings (exact per-leaf shard bytes, the
   tests/test_memory_fit.py account), plus the big transients (grads at
@@ -62,6 +67,15 @@ def _sharded_elements(abstract_tree, shardings_tree) -> int:
             if hasattr(sh, "shard_shape") else aval.shape
         total += int(np.prod(shape, dtype=np.int64))
     return total
+
+
+def link_gbps(op: str, config: PlanConfig, process_count: int) -> float:
+    """The modeled bandwidth ONE declared collective op rides (module
+    docstring): ``_ici``-suffixed ops always score at ICI speed; every
+    other op crosses DCN exactly when the run spans processes."""
+    if op.endswith("_ici"):
+        return config.ici_gbps
+    return config.dcn_gbps if process_count > 1 else config.ici_gbps
 
 
 def device_memory_budget(device, config: PlanConfig) -> Optional[int]:
@@ -128,8 +142,9 @@ def estimate_candidate(
     op_bytes = strategy.step_collective_bytes(mesh, abstract_state,
                                               comm=grad_sync)
     comm_bytes = int(sum(op_bytes.values()))
-    gbps = config.dcn_gbps if process_count > 1 else config.ici_gbps
-    comm_seconds = bytes_to_seconds(comm_bytes, gbps)
+    comm_seconds = sum(
+        bytes_to_seconds(b, link_gbps(op, config, process_count))
+        for op, b in op_bytes.items())
 
     state_bytes = sharded_bytes(abstract_state, shardings)
     # grads mirror the param sharding at param dtype; fp32 update deltas

@@ -261,7 +261,10 @@ class Planner:
                 continue
             programs.append((b.candidate.label, jitted,
                              (b.abstract, gb_abstract),
-                             b.strategy.data_parallel_size(b.mesh)))
+                             b.strategy.data_parallel_size(b.mesh),
+                             getattr(b.grad_sync, "ici_size", 0)
+                             if getattr(b.grad_sync, "hierarchical",
+                                        False) else 0))
         scored = compile_scored(programs)
         cache_misses = compile_cache.stats().misses - misses_before
 
@@ -290,7 +293,17 @@ class Planner:
                 continue
             from ray_lightning_tpu.comm.audit import bytes_to_seconds
             gbps = cfg.dcn_gbps if pc > 1 else cfg.ici_gbps
-            audited_seconds = bytes_to_seconds(sc.wire_bytes, gbps)
+            if sc.wire_bytes_dcn or sc.wire_bytes_ici:
+                # hierarchical candidate: audited bytes re-rank at
+                # per-link bandwidths, mirroring the modeled score
+                # (plan/cost.py link_gbps) — charging the fp32 ICI
+                # phases at DCN speed would un-rank the exact programs
+                # the hierarchy exists to favor
+                audited_seconds = (
+                    bytes_to_seconds(sc.wire_bytes_dcn, gbps)
+                    + bytes_to_seconds(sc.wire_bytes_ici, cfg.ici_gbps))
+            else:
+                audited_seconds = bytes_to_seconds(sc.wire_bytes, gbps)
             mismatch = 0 if b.candidate.donate \
                 == b.estimate.donate_preferred else 1
             key = (audited_seconds, mismatch, sc.peak_bytes,

@@ -78,6 +78,11 @@ CORE_METRICS = (
     "rlt_collective_bytes_total",
     "rlt_collective_ops_total",
     "rlt_collective_seconds_total",
+    # comm plane (comm/collectives.py hierarchical sync): bytes the
+    # step's declared collectives push across the slow DCN tier, and
+    # the bench-measured exposed (non-overlapped) comm seconds per step
+    "rlt_comm_dcn_bytes_total",
+    "rlt_comm_exposed_seconds",
     "rlt_data_wait_seconds_total",
     "rlt_telemetry_dropped_total",
     # trace plane (telemetry/tracing.py + serve per-request tracing):
@@ -220,6 +225,10 @@ class MetricsRegistry:
         #: op -> bytes one execution of the compiled step moves (filled
         #: at trace time; multiplied by executed steps in on_step)
         self.traced_bytes: dict[str, int] = {}
+        #: the subset of traced bytes that crosses the DCN tier
+        #: (comm/audit.py declared_dcn_bytes) — charged per step into
+        #: rlt_comm_dcn_bytes_total
+        self.traced_dcn_bytes: int = 0
         self.last_collective: Optional[str] = None
         self.current_step = 0
         self.last_hbm_bytes = 0
@@ -444,15 +453,22 @@ def note_traced_collective(op: str, nbytes_per_step: int) -> None:
     reg.last_collective = op
 
 
-def note_step_collectives(op_bytes: dict) -> None:
+def note_step_collectives(op_bytes: dict,
+                          dcn_bytes: Optional[int] = None) -> None:
     """Bulk :func:`note_traced_collective` (the trainer registers the
-    strategy's implied gradient/param collectives in one call)."""
+    strategy's implied gradient/param collectives in one call).
+    ``dcn_bytes`` (comm/audit.py ``declared_dcn_bytes``) is the
+    DCN-crossing share, charged per executed step into
+    ``rlt_comm_dcn_bytes_total`` so the hierarchical sync's inter-host
+    savings are a scrapeable series."""
     reg = _registry
     if reg is None:
         return
     for op, nbytes in (op_bytes or {}).items():
         if nbytes > 0:
             reg.traced_bytes[op] = int(nbytes)
+    if dcn_bytes is not None:
+        reg.traced_dcn_bytes = int(dcn_bytes)
 
 
 def on_step(duration_s: float, k: int = 1,
@@ -475,6 +491,21 @@ def on_step(duration_s: float, k: int = 1,
         for op, nbytes in reg.traced_bytes.items():
             bytes_c.inc(nbytes * k, op=op)
             ops_c.inc(k, op=op)
+    if reg.traced_dcn_bytes:
+        reg.counter("rlt_comm_dcn_bytes_total").inc(
+            reg.traced_dcn_bytes * k)
+
+
+def note_exposed_comm(seconds: float) -> None:
+    """Record the measured EXPOSED (non-overlapped) comm seconds per
+    step — what a bench A/B leg pays at the sync barrier after overlap
+    is accounted for (benchmarks/bench_comm.py sets it; the gauge makes
+    exposed-vs-overlapped comm a live series next to the byte
+    counters)."""
+    reg = _registry
+    if reg is None:
+        return
+    reg.gauge("rlt_comm_exposed_seconds").set(float(seconds))
 
 
 def on_compile() -> None:

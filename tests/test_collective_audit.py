@@ -239,6 +239,85 @@ def test_compressed_zero1_grad_phase_bytes(programs, compressed):
     assert grad_fp / grad_q >= 3.5, (grad_fp, grad_q, fp, q)
 
 
+HIER_POLICY = CommPolicy(compress="int8", axes=("data",), hierarchy=4)
+
+
+@pytest.fixture(scope="module")
+def hierarchical(programs):
+    """The two-level (ici4 x dcn2) int8 ddp/zero1 programs."""
+    out = {}
+    for name in ("ddp", "zero1"):
+        _mesh, comp = _compiled(name, comm_policy=HIER_POLICY)
+        out[name] = {"text": comp.as_text()}
+    return out
+
+
+@pytest.mark.parametrize("name", ["ddp", "zero1"])
+def test_hierarchical_dcn_bytes_vs_flat_int8(compressed, hierarchical,
+                                             name):
+    """THE tentpole pin: on a 2-level (ici4 x dcn2) split of the 8-way
+    mesh, the hierarchical program's DCN-crossing compressed payload is
+    >= 2x below the flat-int8 path's (the flat collectives span all 8
+    ranks, so every compressed byte crosses hosts; the hierarchical
+    level-2 phases move a 1/ici shard).  Audited over the lowered HLO's
+    replica groups — a lost ``axis_index_groups`` (everything suddenly
+    full-span) fails here, not on a pod."""
+    from ray_lightning_tpu.comm.audit import wire_bytes_by_link
+
+    qdt = ("s8", "u8")
+    flat = wire_bytes_by_link(compressed[name]["text"], ici_size=4,
+                              axis_size=8, dtypes=qdt)
+    hier = wire_bytes_by_link(hierarchical[name]["text"], ici_size=4,
+                              axis_size=8, dtypes=qdt)
+    assert flat["dcn"] > 0 and hier["dcn"] > 0, (flat, hier)
+    assert flat["ici"] == 0, flat    # flat program: all spans cross
+    assert 2 * hier["dcn"] <= flat["dcn"], (hier, flat)
+
+
+def test_hierarchical_ici_phases_stay_fp32(hierarchical):
+    """The EQuARX trade in the lowered program: the hierarchical ddp
+    step moves fp32 INSIDE the ICI groups (levels 1/3 — the fast link
+    carries full precision) while the compressed dtype appears only on
+    host-crossing groups."""
+    from ray_lightning_tpu.comm.audit import wire_bytes_by_link
+
+    t = hierarchical["ddp"]["text"]
+    f32 = wire_bytes_by_link(t, ici_size=4, axis_size=8, dtypes=("f32",),
+                             ops=("all-to-all", "all-gather"))
+    assert f32["ici"] > 0, f32
+    q = wire_bytes_by_link(t, ici_size=4, axis_size=8, dtypes=("s8", "u8"))
+    assert q["ici"] == 0, q          # codec never rides the fast tier
+
+
+def test_fp8_program_rides_one_byte_wire():
+    """The fp8 codec's collectives must move a 1-byte element type (the
+    u8 bitcast) — an f16-widened wire (what a raw f8 collective lowers
+    to on CPU) would silently double the DCN bytes."""
+    _mesh, comp = _compiled(
+        "ddp", comm_policy=CommPolicy(compress="fp8", axes=("data",)))
+    wire = collective_wire_bytes(comp.as_text(), axis_size=8)
+    assert ("all-to-all", "u8") in wire and ("all-gather", "u8") in wire, \
+        wire
+    assert not any(dt == "f16" for _op, dt in wire), wire
+
+
+def test_int4_program_halves_the_payload():
+    """int4's packed wire: the all-to-all payload is half the element
+    count, so total compressed bytes land >= 1.6x under the int8 leg's
+    (scales are the fixed overhead)."""
+    _mesh, comp8 = _compiled("ddp", comm_policy=INT8_POLICY)
+    _mesh, comp4 = _compiled(
+        "ddp", comm_policy=CommPolicy(compress="int4", axes=("data",)))
+    qdt = ("s8", "u8")
+    b8 = sum(b for (op, dt), b in
+             collective_wire_bytes(comp8.as_text(), axis_size=8).items()
+             if dt in qdt)
+    b4 = sum(b for (op, dt), b in
+             collective_wire_bytes(comp4.as_text(), axis_size=8).items()
+             if dt in qdt)
+    assert b4 * 1.6 <= b8, (b4, b8)
+
+
 def test_comm_policy_off_is_bit_identical(programs):
     """The resolved-but-off policy (compress="none") routes through the
     comm-aware wiring and must produce the IDENTICAL program text —

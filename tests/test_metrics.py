@@ -149,6 +149,22 @@ def test_traced_collectives_charged_per_executed_step():
     assert reg.current_step == 3
 
 
+def test_dcn_bytes_charged_per_executed_step():
+    """The hierarchical comm plane's DCN-crossing share (op suffixes →
+    comm/audit.py declared_dcn_bytes) lands on its own counter, charged
+    per step like the traced collectives."""
+    from ray_lightning_tpu.comm.audit import declared_dcn_bytes
+
+    reg = telemetry.enable_metrics(pump=False)
+    ops = {"grad_all_reduce_dcn": 40, "grad_all_reduce_ici": 400}
+    M.note_step_collectives(ops, dcn_bytes=declared_dcn_bytes(ops, True))
+    M.on_step(0.01, k=2, step=2)
+    assert reg.counter("rlt_comm_dcn_bytes_total").value() == 40 * 2
+    M.note_exposed_comm(0.012)
+    assert reg.gauge("rlt_comm_exposed_seconds").value() \
+        == pytest.approx(0.012)
+
+
 def test_ring_attention_registers_rotation_bytes():
     from ray_lightning_tpu.parallel.mesh import (build_device_mesh,
                                                  set_current_mesh)

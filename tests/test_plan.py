@@ -258,6 +258,85 @@ def test_auto_reuses_plan_inside_tune_trial(tmp_path):
         clear_plan_memo()
 
 
+# -- per-link scoring + measured-bandwidth calibration ---------------------
+
+def test_link_gbps_per_op_attribution():
+    """``_ici``-suffixed ops always score at ICI speed; everything else
+    rides DCN exactly when the run spans processes — the attribution
+    that keeps hierarchical candidates ranked right."""
+    from ray_lightning_tpu.plan.cost import link_gbps
+
+    cfg = PlanConfig(ici_gbps=100.0, dcn_gbps=10.0)
+    assert link_gbps("grad_all_reduce_ici", cfg, 2) == 100.0
+    assert link_gbps("grad_all_reduce_dcn", cfg, 2) == 10.0
+    assert link_gbps("grad_all_reduce", cfg, 2) == 10.0
+    assert link_gbps("grad_all_reduce_dcn", cfg, 1) == 100.0
+    assert link_gbps("param_all_gather", cfg, 1) == 100.0
+
+
+def test_hierarchical_candidate_scores_below_mischarged(seed):
+    """A hierarchical GradSync declares ~8 bytes/element of fp32 ICI
+    traffic; scoring it at per-link bandwidths must come out CHEAPER
+    than the flat int8 candidate's all-DCN charge (the mis-ranking the
+    per-op attribution exists to prevent)."""
+    from ray_lightning_tpu.comm import build_grad_sync
+    from ray_lightning_tpu.plan.candidates import policy_for_candidate
+
+    module = _boring()
+    batch = _example_batch(module)
+    strat = resolve_strategy("ddp")
+    mesh = strat.build_mesh(batch_hint=BATCH)
+    tx = module.configure_optimizers()
+    abstract = jax.eval_shape(build_init_fn(module, tx),
+                              jax.random.PRNGKey(0), batch)
+    shardings = strat.state_shardings(mesh, abstract)
+    cfg = PlanConfig(ici_gbps=100.0, dcn_gbps=1.0)
+    cand = Candidate(strategy="ddp", axis_sizes=(("data", 8),), comm=True)
+    batch_bytes = sum(
+        a.size * a.dtype.itemsize for a in jax.tree_util.tree_leaves(batch))
+
+    def score(policy):
+        sync = build_grad_sync(strat, mesh, policy)
+        return estimate_candidate(cand, strat, mesh, abstract, shardings,
+                                  batch_bytes, cfg, process_count=2,
+                                  grad_sync=sync).comm_seconds
+
+    flat = score(CommPolicy(compress="int8", axes=("data",)))
+    hier = score(CommPolicy(compress="int8", axes=("data",), hierarchy=4))
+    assert hier < flat, (hier, flat)
+    # the planner's default comm-on candidate policy arms the hierarchy
+    pol = policy_for_candidate(cand)
+    assert pol.hierarchy != 0
+
+
+def test_calibration_cache_roundtrip(tmp_path, monkeypatch):
+    """RLT_PLAN_CALIBRATE=1: PlanConfig.resolve picks up measured link
+    bandwidths, cached per topology fingerprint (second resolve reads
+    the file); explicit RLT_PLAN_*_GBPS still wins."""
+    import json
+
+    from ray_lightning_tpu.comm import calibrate
+
+    monkeypatch.setenv(calibrate.ENV_DIR, str(tmp_path))
+    monkeypatch.setenv("RLT_PLAN_CALIBRATE", "1")
+    cfg = PlanConfig.resolve(None)
+    path = calibrate.cache_path()
+    assert tmp_path.joinpath(path.split("/")[-1]).exists()
+    data = json.loads(open(path).read())
+    # the 8-virtual-device CPU mesh measures its ICI proxy; DCN has no
+    # hop to measure and keeps the constant
+    assert "ici" in data["measured"]
+    assert cfg.ici_gbps == data["ici_gbps"] > 0
+    assert cfg.dcn_gbps == data["dcn_gbps"]
+    # cache hit: mutate the file, re-resolve, the mutated value is read
+    data["ici_gbps"] = 123.456
+    open(path, "w").write(json.dumps(data))
+    assert PlanConfig.resolve(None).ici_gbps == 123.456
+    # explicit env overrides beat calibration
+    monkeypatch.setenv("RLT_PLAN_ICI_GBPS", "77.0")
+    assert PlanConfig.resolve(None).ici_gbps == 77.0
+
+
 # -- resolve_strategy surface (satellite: docstring/README drift) ----------
 
 def test_resolve_strategy_unknown_name_lists_valid_set():
@@ -277,48 +356,58 @@ def test_resolve_auto_returns_sentinel():
 
 # -- model-drift guard: declared bytes vs audited HLO ----------------------
 
+#: drift legs: (strategy, key) -> CommPolicy (None = uncompressed).
+#: False/True keep PR-8's flat keys; "hier"/"fp8" are the PR-10 paths.
+_DRIFT_LEGS = (
+    ("ddp", False, None),
+    ("ddp", True, CommPolicy(compress="int8", axes=("data",))),
+    ("zero1", False, None),
+    ("zero1", True, CommPolicy(compress="int8", axes=("data",))),
+    ("ddp", "hier", CommPolicy(compress="int8", axes=("data",),
+                               hierarchy=4)),
+    ("ddp", "fp8", CommPolicy(compress="fp8", axes=("data",))),
+)
+
+
 @pytest.fixture(scope="module")
 def drift_programs():
-    """Compile the REAL train step for (ddp, zero1) × (comm off, int8)
-    on the 8-device mesh; yield declared step_collective_bytes next to
+    """Compile the REAL train step for every ``_DRIFT_LEGS`` entry on
+    the 8-device mesh; yield declared step_collective_bytes next to
     the audited HLO wire bytes of the same lowered program."""
     from ray_lightning_tpu.models.gpt import GPTLightningModule
 
     out = {}
-    for name in ("ddp", "zero1"):
-        for comm in (False, True):
-            module = GPTLightningModule("tiny", dataset_size=4 * BATCH,
-                                        batch_size=BATCH)
-            module.setup_model()
-            strat = resolve_strategy(name)
-            mesh = strat.build_mesh(batch_hint=BATCH)
-            policy = CommPolicy(compress="int8", axes=("data",)) \
-                if comm else None
-            sync = strat.grad_transform(mesh, policy) if comm else None
-            tx = module.configure_optimizers()
-            if sync is not None:
-                tx = sync.wrap_tx(tx)
-            batch = jax.tree_util.tree_map(
-                np.asarray, next(iter(module.train_dataloader())))
-            abstract = jax.eval_shape(build_init_fn(module, tx),
-                                      jax.random.PRNGKey(0), batch)
-            shardings = strat.state_shardings(mesh, abstract)
-            if sync is not None:
-                shardings = shardings.replace(
-                    opt_state=sync.fix_opt_shardings(
-                        shardings.opt_state, abstract.opt_state))
-            jitted = jax.jit(
-                build_train_step(module, tx, grad_sync=sync),
-                donate_argnums=0,
-                in_shardings=(shardings,
-                              strat.batch_shardings(mesh, batch)),
-                out_shardings=(shardings, None))
-            compiled = jitted.lower(abstract, batch).compile()
-            out[(name, comm)] = {
-                "declared": strat.step_collective_bytes(mesh, abstract,
-                                                        comm=sync),
-                "text": compiled.as_text(),
-            }
+    for name, comm, policy in _DRIFT_LEGS:
+        module = GPTLightningModule("tiny", dataset_size=4 * BATCH,
+                                    batch_size=BATCH)
+        module.setup_model()
+        strat = resolve_strategy(name)
+        mesh = strat.build_mesh(batch_hint=BATCH)
+        sync = strat.grad_transform(mesh, policy) if comm else None
+        tx = module.configure_optimizers()
+        if sync is not None:
+            tx = sync.wrap_tx(tx)
+        batch = jax.tree_util.tree_map(
+            np.asarray, next(iter(module.train_dataloader())))
+        abstract = jax.eval_shape(build_init_fn(module, tx),
+                                  jax.random.PRNGKey(0), batch)
+        shardings = strat.state_shardings(mesh, abstract)
+        if sync is not None:
+            shardings = shardings.replace(
+                opt_state=sync.fix_opt_shardings(
+                    shardings.opt_state, abstract.opt_state))
+        jitted = jax.jit(
+            build_train_step(module, tx, grad_sync=sync),
+            donate_argnums=0,
+            in_shardings=(shardings,
+                          strat.batch_shardings(mesh, batch)),
+            out_shardings=(shardings, None))
+        compiled = jitted.lower(abstract, batch).compile()
+        out[(name, comm)] = {
+            "declared": strat.step_collective_bytes(mesh, abstract,
+                                                    comm=sync),
+            "text": compiled.as_text(),
+        }
     return out
 
 
@@ -371,3 +460,48 @@ def test_drift_compressed_declaration_tracks_audit(drift_programs, name):
     audited_f = total_wire_bytes(flat["text"], axis_size=8)
     assert 0.7 <= audited_c / declared_c <= 2.0, (audited_c, declared_c)
     assert audited_c * 2.0 <= audited_f, (audited_c, audited_f)
+
+
+def test_drift_hierarchical_per_link_attribution(drift_programs):
+    """The hierarchical (ici4 x dcn2) declaration is split by link tier
+    (``_dcn``/``_ici`` op suffixes) and BOTH sides must track the
+    audited per-link HLO bytes: the DCN share against the host-crossing
+    replica groups, the ICI share against the intra-host ones.  The
+    manual lowering is the comm plane's own, so the bands are tight
+    (same 0.7-2.0 calibration as the flat compressed legs) — a planner
+    scoring hierarchical candidates from a declaration that silently
+    stops splitting (or an audit that loses the groups) leaves them."""
+    from ray_lightning_tpu.comm.audit import wire_bytes_by_link
+
+    p = drift_programs[("ddp", "hier")]
+    declared_dcn = sum(b for op, b in p["declared"].items()
+                       if op.endswith("_dcn"))
+    declared_ici = sum(b for op, b in p["declared"].items()
+                       if op.endswith("_ici"))
+    assert declared_dcn > 0 and declared_ici > 0, p["declared"]
+    audited = wire_bytes_by_link(p["text"], ici_size=4, axis_size=8,
+                                 ops=("all-to-all", "all-gather"))
+    assert 0.7 <= audited["dcn"] / declared_dcn <= 2.0, (
+        audited, declared_dcn)
+    assert 0.7 <= audited["ici"] / declared_ici <= 2.0, (
+        audited, declared_ici)
+    # and the hierarchy's point: declared DCN bytes are >= 2x under the
+    # flat int8 declaration's total (only the 1/ici shard crosses)
+    flat_declared = sum(drift_programs[("ddp", True)]["declared"].values())
+    assert 2 * declared_dcn <= flat_declared, (declared_dcn, flat_declared)
+
+
+def test_drift_fp8_declaration_tracks_audit(drift_programs):
+    """fp8's declaration (same wire bytes as int8: one byte/element +
+    fp32 block scales) against the audited u8 program — same calibrated
+    band as the int8 legs, so a codec whose wire silently widens (the
+    f16 upcast a raw f8 collective lowers to) fails the drift guard."""
+    p = drift_programs[("ddp", "fp8")]
+    declared = sum(p["declared"].values())
+    audited = total_wire_bytes(p["text"], axis_size=8)
+    assert 0.7 <= audited / declared <= 2.0, (audited, declared)
+    # the wire rides 1-byte u8, never f16
+    from ray_lightning_tpu.comm.audit import collective_wire_bytes
+    wire = collective_wire_bytes(p["text"], axis_size=8)
+    assert any(dt == "u8" for _op, dt in wire), wire
+    assert not any(dt == "f16" for _op, dt in wire), wire
