@@ -1,0 +1,121 @@
+"""Pipeline bench: MPMD per-stage programs vs the SPMD GPipe monolith.
+
+One JSON line per leg in the shared harness format
+(``python -m benchmarks.bench_pipeline``):
+
+- ``pipeline_spmd`` — the existing one-program GPipe
+  (parallel/pipeline.py, ``PipelineStrategy(stages=2)``): the baseline
+  the MPMD legs one-diff against (same model, same microbatches, same
+  seed).
+- ``mpmd_gpipe`` / ``mpmd_1f1b`` — the MPMD engine under each
+  schedule.  Each line's ``mpmd`` field carries per-stage compile
+  seconds, the simulated bubble fraction PER SCHEDULE (replayed from
+  measured per-op times — the CPU proxy executes serially, so wall
+  clock cannot show overlap; same caveat as bench_comm), and
+  activation bytes/step.  The 1f1b leg auto-interleaves (v=2 on the
+  4-layer config), which is where its bubble drops below GPipe's —
+  plain 1F1B ties GPipe analytically (mpmd/schedule.py).
+- ``mpmd_1f1b_fp8`` — the codec-on-activations leg; its line adds
+  ``activation_bytes_by_codec``, the wire-size menu of the whole codec
+  family for this boundary shape.
+
+A ``bubble_win`` summary line states the 1f1b-vs-gpipe comparison the
+acceptance bar reads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+WARMUP = 2
+TIMED = 8
+STAGES = 2
+MICRO = 4
+
+
+def _model():
+    from ray_lightning_tpu.models.gpt import GPTConfig
+    from ray_lightning_tpu.models.pipeline_gpt import PipelinedGPT
+
+    # 4 layers so the 1f1b leg can interleave (2 chunks/stage); tiny
+    # dims keep the CPU legs honest about schedule, not matmul, time
+    cfg = GPTConfig(vocab_size=512, block_size=64, n_layer=4, n_head=2,
+                    n_embd=64, remat=False)
+    # batch 16: the SPMD baseline's (data=4, stage=2) mesh leaves a
+    # per-shard batch of 4 = MICRO microbatches; the MPMD legs split
+    # the same global batch into the same 4 microbatches
+    return PipelinedGPT(cfg, n_microbatches=MICRO, dataset_size=256,
+                        batch_size=16)
+
+
+def main() -> None:
+    import jax
+
+    if len(jax.devices()) < 2:
+        # same re-exec proxy bench_comm uses: the SPMD baseline needs a
+        # real stage axis
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+        env["JAX_PLATFORMS"] = "cpu"
+        raise SystemExit(subprocess.call(
+            [sys.executable, "-m", "benchmarks.bench_pipeline"], env=env))
+
+    from benchmarks.harness import run_steps_per_sec
+    from ray_lightning_tpu.mpmd import MpmdConfig, MpmdPipelineStrategy
+    from ray_lightning_tpu.mpmd.partition import activation_wire_bytes
+    from ray_lightning_tpu.parallel.pipeline import PipelineStrategy
+
+    run_steps_per_sec(
+        _model(), "pipeline_spmd_steps_per_sec", warmup=WARMUP,
+        timed=TIMED, strategy=PipelineStrategy(stages=STAGES),
+        telemetry=False,
+        extra_fields={"stages": STAGES, "microbatches": MICRO,
+                      "schedule": "gpipe-spmd"})
+
+    results = {}
+    for tag, cfg in (
+        ("mpmd_gpipe", MpmdConfig(stages=STAGES, schedule="gpipe",
+                                  microbatches=MICRO)),
+        ("mpmd_1f1b", MpmdConfig(stages=STAGES, schedule="1f1b",
+                                 microbatches=MICRO)),
+        ("mpmd_1f1b_fp8", MpmdConfig(stages=STAGES, schedule="1f1b",
+                                     microbatches=MICRO, codec="fp8")),
+    ):
+        extra = None
+        if cfg.codec != "none":
+            # wire-size menu for this boundary shape: [mb, T, C] bf16
+            module = _model()
+            mcfg = module.config
+            boundary = (module.batch_size // MICRO) * mcfg.block_size \
+                * mcfg.n_embd * 2
+            extra = {"activation_bytes_by_codec": {
+                c: activation_wire_bytes(boundary, STAGES - 1, MICRO,
+                                         codec=c)
+                for c in ("none", "bf16", "int8", "fp8", "int4")}}
+        results[tag] = run_steps_per_sec(
+            _model(), f"{tag}_steps_per_sec", warmup=WARMUP,
+            timed=TIMED, strategy=MpmdPipelineStrategy(cfg),
+            telemetry=False, extra_fields=extra)
+
+    bubbles = results["mpmd_1f1b"].get("mpmd", {}).get(
+        "bubble_fraction", {})
+    print(json.dumps({
+        "metric": "mpmd_bubble_win",
+        "gpipe_bubble_fraction": bubbles.get("gpipe"),
+        "1f1b_bubble_fraction": bubbles.get("1f1b"),
+        "1f1b_below_gpipe": (
+            bubbles.get("1f1b", 1.0) < bubbles.get("gpipe", 0.0)),
+        "microbatches": MICRO,
+        "note": "simulated from measured per-op seconds; 1f1b "
+                "interleaves (v=2) — plain 1f1b ties gpipe "
+                "(mpmd/schedule.py)",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -144,6 +144,25 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
     # trainer._plan_report) or hand-configured ("manual")
     result["plan"] = ("auto" if getattr(trainer, "_plan_report", None)
                       else "manual")
+    # MPMD plane: per-stage compile seconds, simulated bubble fractions
+    # per schedule and activation wire bytes (mpmd/engine.py report) —
+    # the fields bench_pipeline.py's one-diff comparison reads
+    rep = getattr(trainer, "_mpmd_report", None)
+    if rep:
+        result["mpmd"] = {
+            "schedule": rep["schedule"],
+            "stages": rep["stages"],
+            "virtual": rep.get("virtual", 1),
+            "cuts": rep.get("cuts"),
+            "codec": rep["codec"],
+            "per_stage_compile_seconds":
+                rep.get("per_stage_compile_seconds"),
+            "bubble_fraction": {
+                k: v["bubble_fraction"]
+                for k, v in rep.get("bubble", {}).items()},
+            "activation_bytes_per_step":
+                rep.get("activation_bytes_per_step"),
+        }
     paths = getattr(trainer, "_telemetry_paths", None)
     if paths:
         result["telemetry_jsonl"] = paths["jsonl"]

@@ -67,6 +67,13 @@ if [[ "$CHECK" == 1 ]]; then
     # (ray_lightning_tpu/plan/selfcheck.py)
     python -c 'import sys; from ray_lightning_tpu.plan.selfcheck \
         import _main; sys.exit(_main([]))'
+    # mpmd-plane selfcheck: schedule invariants (every microbatch F
+    # before its B, 1F1B depth <= stages x virtual, the plain-1F1B
+    # bubble tie + interleaved win), RLT_MPMD* env round-trip, channel
+    # codec round-trip / out-of-order / dead-peer timeout, stage-cut
+    # resolution, metric names (ray_lightning_tpu/mpmd/selfcheck.py)
+    python -c 'import sys; from ray_lightning_tpu.mpmd.selfcheck \
+        import _main; sys.exit(_main([]))'
     # trace-plane selfcheck: span-record schema, trace-context
     # round-trip (driver + worker spans reassemble one request tree),
     # flight-recorder bounded-size invariant, profile-controller state

@@ -106,8 +106,13 @@ class ClusterBackend:
         env: Optional[dict[str, str]] = None,
         resources: Optional[dict[str, float]] = None,
         name: Optional[str] = None,
+        max_concurrency: Optional[int] = None,
         **kwargs,
     ) -> ActorHandle:
+        """``max_concurrency`` matters to actors on the worker↔worker
+        peer channel (cluster/peer.py): Ray delivers peer payloads as
+        concurrent method calls, so receivers need >= 2; the builtin
+        backend delivers via its frame reader thread and ignores it."""
         raise NotImplementedError
 
     def put(self, obj: Any) -> Any:
@@ -126,6 +131,17 @@ class ClusterBackend:
     def queue_get_nowait(self):
         """Pop one worker→driver queue item or None."""
         raise NotImplementedError
+
+    def peer_route(self, dst_actor_id: str, item) -> bool:
+        """Driver-side hop of the worker↔worker peer channel
+        (cluster/peer.py): deliver ``item`` to ``dst_actor_id``'s
+        process.  Backends whose workers reach each other directly
+        (Ray named actors) never call this; the builtin backend routes
+        through the driver socket fan-in.  Returns False when the
+        destination is unknown (receiver-side timeouts do the
+        failure naming)."""
+        del dst_actor_id, item
+        return False
 
     def available_resources(self) -> dict[str, float]:
         return {}

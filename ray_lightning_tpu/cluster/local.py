@@ -134,6 +134,11 @@ class LocalActorHandle(ActorHandle):
                         fut.set_error(RemoteActorError(msg["error"]))
                 elif kind == "queue":
                     self._backend._queue_push(msg["item"])
+                elif kind == "peer":
+                    # worker↔worker channel (cluster/peer.py): this
+                    # reader thread is per-actor, so routing here keeps
+                    # peer traffic flowing while other actors compute
+                    self._backend.peer_route(msg["dst"], msg["item"])
         except (ConnectionError, OSError):
             silent = (f"; last frame "
                       f"{time.monotonic() - self.last_frame_at:.1f}s ago"
@@ -298,10 +303,29 @@ class LocalBackend(ClusterBackend):
 
     # -- actors -----------------------------------------------------------
 
+    def peer_route(self, dst_actor_id: str, item) -> bool:
+        """Route one peer payload to ``dst_actor_id``'s connection
+        (frame delivered by the worker's reader thread straight into
+        its peer mailbox — worker_main.py)."""
+        handle = self._actors.get(dst_actor_id)
+        if handle is None or handle._conn is None:
+            print(f"[rlt-backend] dropping peer payload for unknown or "
+                  f"unattached actor {dst_actor_id!r}",
+                  file=sys.stderr, flush=True)
+            return False
+        try:
+            handle._conn.send({"type": "peer", "item": item})
+            return True
+        except (ConnectionError, OSError):
+            return False
+
     def create_actor(self, actor_cls: type, *args,
                      env: Optional[dict[str, str]] = None,
                      resources: Optional[dict[str, float]] = None,
-                     name: Optional[str] = None, **kwargs) -> ActorHandle:
+                     name: Optional[str] = None,
+                     max_concurrency: Optional[int] = None,
+                     **kwargs) -> ActorHandle:
+        del max_concurrency   # peer frames ride the reader thread here
         actor_id = name or f"actor-{uuid.uuid4().hex[:8]}"
         spec_path = os.path.join(self._dir, f"{actor_id}.spec")
         with open(spec_path, "wb") as f:

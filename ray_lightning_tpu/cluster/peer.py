@@ -1,0 +1,68 @@
+"""Worker↔worker peer channel primitives.
+
+The cluster backends' third data plane, next to actor RPC
+(driver→worker) and the queue (worker→driver): tagged payloads
+travelling BETWEEN workers.  The MPMD pipeline's activation exchange
+(ray_lightning_tpu/mpmd/channel.py) is the first consumer.
+
+Transport per backend:
+
+- builtin (cluster/local.py): the sender emits a ``peer`` frame on its
+  driver socket; the driver's per-actor reader routes it to the
+  destination actor's connection, whose frame-reader thread
+  (cluster/worker_main.py) deposits it into this process's
+  :func:`peer mailbox <ray_lightning_tpu.cluster.worker_state.peer_mailbox>`
+  without waiting for the main thread (which may be busy executing the
+  receiving actor's current call — that's the point).
+- Ray (cluster/ray_backend.py): the sender resolves the destination's
+  named actor handle and calls its ``__rlt_peer_deliver__`` method;
+  the destination actor must be created with ``max_concurrency >= 2``
+  so the delivery thread runs beside the busy main call.
+
+:class:`Mailbox` is the receiving side either way: a tag-addressed
+blocking store — out-of-order delivery is harmless by construction (a
+receive blocks on ITS tag), and a receive that outlives its timeout
+raises :class:`PeerTimeout` naming the waiter and the missing payload
+instead of hanging the fleet.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+
+class PeerTimeout(RuntimeError):
+    """A worker waited longer than the dead-peer bound for a payload."""
+
+
+class Mailbox:
+    """Thread-safe tag-addressed blocking store."""
+
+    def __init__(self):
+        self._items: dict = {}
+        self._cond = threading.Condition()
+
+    def put(self, tag: tuple, payload: Any) -> None:
+        with self._cond:
+            self._items[tag] = payload
+            self._cond.notify_all()
+
+    def take(self, tag: tuple, timeout: float, *, who: str = "worker",
+             src: str = "peer") -> Any:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while tag not in self._items:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise PeerTimeout(
+                        f"{who} timed out after {timeout:.1f}s waiting "
+                        f"for peer payload {tag!r} from {src} — peer "
+                        f"dead or schedules desynchronized")
+                self._cond.wait(remaining)
+            return self._items.pop(tag)
+
+    def __len__(self):
+        with self._cond:
+            return len(self._items)

@@ -210,7 +210,9 @@ class RayBackend(ClusterBackend):
     def create_actor(self, actor_cls: type, *args,
                      env: Optional[dict[str, str]] = None,
                      resources: Optional[dict[str, float]] = None,
-                     name: Optional[str] = None, **kwargs) -> ActorHandle:
+                     name: Optional[str] = None,
+                     max_concurrency: Optional[int] = None,
+                     **kwargs) -> ActorHandle:
         resources = dict(resources or {})
         num_cpus = resources.pop("CPU", 1)
         num_gpus = resources.pop("GPU", 0)
@@ -223,6 +225,15 @@ class RayBackend(ClusterBackend):
         if env:
             options["runtime_env"] = {"env_vars": {
                 k: str(v) for k, v in env.items()}}
+        if name:
+            # named + namespaced so peers can ray.get_actor each other
+            # (the worker↔worker channel's Ray transport — peer_send)
+            options["name"] = name
+        if max_concurrency:
+            # peer deliveries arrive as concurrent method calls on Ray
+            # (cluster/peer.py): without this they would queue behind
+            # the receiver's in-flight step and deadlock the exchange
+            options["max_concurrency"] = int(max_concurrency)
         remote_cls = ray.remote(actor_cls)
         actor = remote_cls.options(**options).remote(*args, **kwargs)
         return RayActorHandle(actor)

@@ -6,13 +6,23 @@ from its pickled spec, then serves calls sequentially on the main thread
 emitted mid-call through :func:`queue_send` — that is the transport under
 ``session.put_queue`` (the reference's ray.util.queue relay,
 session.py:17-24 / util.py:47-52).
+
+Inbound frames are drained by a dedicated reader thread: ``call`` /
+``shutdown`` frames queue for the main thread (execution stays
+sequential), while ``peer`` frames — the worker↔worker channel
+(cluster/peer.py) — deposit straight into this process's peer mailbox.
+Without the split, a peer payload could not arrive while the main
+thread is busy executing the very call that wants to receive it (the
+MPMD stage actors' shape).
 """
 
 from __future__ import annotations
 
 import os
+import queue
 import socket
 import sys
+import threading
 import time
 import traceback
 
@@ -65,11 +75,31 @@ def main() -> int:
         return 1
     _trace("actor constructed; serving")
 
+    # frame reader (module docstring): peer frames bypass the main
+    # thread's call queue so receives inside a running call make
+    # progress; everything else serializes through the inbox
+    inbox: "queue.Queue" = queue.Queue()
+
+    def _reader() -> None:
+        while True:
+            try:
+                msg = _conn.recv()
+            except (ConnectionError, OSError) as e:
+                _trace(f"connection closed ({type(e).__name__}: {e}); "
+                       f"exiting")
+                inbox.put(None)
+                return
+            if msg.get("type") == "peer":
+                worker_state.peer_push(msg["item"])
+            else:
+                inbox.put(msg)
+
+    threading.Thread(target=_reader, daemon=True,
+                     name="rlt-worker-reader").start()
+
     while True:
-        try:
-            msg = _conn.recv()
-        except (ConnectionError, OSError) as e:
-            _trace(f"connection closed ({type(e).__name__}: {e}); exiting")
+        msg = inbox.get()
+        if msg is None:
             return 0
         kind = msg.get("type")
         if kind == "shutdown":

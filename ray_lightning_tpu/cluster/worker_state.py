@@ -11,9 +11,11 @@ from __future__ import annotations
 
 from typing import Optional
 
+from ray_lightning_tpu.cluster.peer import Mailbox
 from ray_lightning_tpu.cluster.protocol import Connection
 
 _conn: Optional[Connection] = None
+_peer_mailbox = Mailbox()
 
 
 def set_conn(conn: Optional[Connection]) -> None:
@@ -30,3 +32,40 @@ def queue_send(item) -> None:
     if _conn is None:
         raise RuntimeError("queue_send outside of a worker process")
     _conn.send({"type": "queue", "item": item})
+
+
+# -- worker↔worker peer channel (cluster/peer.py) ---------------------------
+
+
+def peer_mailbox() -> Mailbox:
+    """This worker process's peer-payload mailbox.  Fed by
+    worker_main's frame reader (builtin backend ``peer`` frames) or by
+    :func:`peer_push` (Ray ``__rlt_peer_deliver__`` calls)."""
+    return _peer_mailbox
+
+
+def peer_push(item: dict) -> None:
+    """Deposit an inbound peer payload ``{"tag": ..., "wire": ...}``."""
+    _peer_mailbox.put(tuple(item["tag"]), item["wire"])
+
+
+def peer_send(dst_actor_name: str, item: dict) -> None:
+    """Send a peer payload to another worker by actor name.
+
+    Builtin backend: a ``peer`` frame on the driver socket, routed by
+    the driver to the destination's connection.  Ray backend (no
+    driver socket in this process): resolve the named actor and call
+    its ``__rlt_peer_deliver__`` (the destination must be created with
+    ``max_concurrency >= 2`` — cluster/peer.py).
+    """
+    if _conn is not None:
+        _conn.send({"type": "peer", "dst": dst_actor_name, "item": item})
+        return
+    try:
+        import ray
+    except ImportError:   # pragma: no cover - no transport available
+        raise RuntimeError(
+            "peer_send outside of a worker process (no driver socket, "
+            "no Ray runtime)")
+    ray.get(ray.get_actor(dst_actor_name).__rlt_peer_deliver__
+            .remote(item))

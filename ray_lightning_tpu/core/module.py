@@ -224,6 +224,19 @@ class LightningModule:
         dropout) the way GPTLightningModule does."""
         return self.configure_model()
 
+    def configure_mpmd(self):
+        """MPMD-plane hook (ray_lightning_tpu/mpmd/): an ``MpmdSpec``
+        describing this model as embed → N identical layers → head so
+        the stage partitioner can slice it into per-stage programs
+        (``Trainer(strategy="mpmd")``).  Models with a stacked-layer
+        param tree (models/pipeline_gpt.py) implement this in a few
+        lines; the default refuses with guidance."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not describe an MPMD "
+            f"partition; implement configure_mpmd() returning an "
+            f"ray_lightning_tpu.mpmd.partition.MpmdSpec (see "
+            f"models/pipeline_gpt.py for the stacked-layer shape)")
+
     def setup_model(self) -> None:
         """Materialize ``self.model`` (idempotent; called on each process)."""
         if self.model is None:

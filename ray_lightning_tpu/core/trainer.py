@@ -406,6 +406,19 @@ class Trainer:
             strategy = self._resolve_auto_strategy(
                 module, example_batch, batch_hint, strategy, stage)
             self.plugin.strategy = strategy
+        if getattr(strategy, "name", "") == "mpmd":
+            # MPMD plane (mpmd/): no SPMD mesh or monolithic train step
+            # exists — the engine builds per-stage programs and runs
+            # the driver-side schedule.  Fit only; evaluate with a
+            # non-mpmd strategy (without a stage axis the model is the
+            # same sequential math).
+            if stage != "fit":
+                raise ValueError(
+                    f"strategy='mpmd' supports fit only (got "
+                    f"{stage!r}); run {stage} under 'ddp' — the model "
+                    f"math is identical without a stage split")
+            from ray_lightning_tpu.mpmd.engine import run_mpmd_fit
+            return run_mpmd_fit(self, module, loaders, example_batch)
         self._mesh = strategy.build_mesh(self.plugin.local_devices(),
                                          batch_hint=batch_hint)
         set_current_mesh(self._mesh)  # for mesh-aware ops (ring attention)
@@ -640,8 +653,9 @@ class Trainer:
         actually skips on v4-64, state ~2.85 GB/device at data=64) is
         budget-checked against v4's 32 GB with its extra un-aliased
         state copy accounted
-        (test_undonated_zero1_budget_in_v4_skip_region and the slow
-        compile-audit leg).  The per-config donation decisions are
+        (test_undonated_zero1_budget_in_v4_skip_region and the direct
+        memory_analysis audit test_undonated_zero1_compile_audit, both
+        tier-1).  The per-config donation decisions are
         additionally pinned in
         tests/test_trainer_local.py::test_donation_decision_table, so a
         change to either side must show up against that table, not

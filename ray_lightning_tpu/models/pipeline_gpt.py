@@ -108,6 +108,51 @@ class PipelinedGPT(LightningModule):
         return optax.adamw(self.lr, weight_decay=self.weight_decay,
                            b1=0.9, b2=0.95)
 
+    # -- MPMD partition (ray_lightning_tpu/mpmd/) ------------------------
+
+    def configure_mpmd(self):
+        """Describe this model for the MPMD stage partitioner
+        (``Trainer(strategy="mpmd")``): embedding and head as pure
+        functions over their own param keys, one layer as the scanned
+        ``stage_fn`` — the exact math of :meth:`_forward`/:meth:`_loss`
+        split at the same seams the GPipe scan uses.  ``wte`` is tied:
+        the embedding owns it, the head reads a mirror (the engine
+        ships the head's wte grad back over the channel and
+        re-broadcasts the updated value)."""
+        import optax
+
+        from ray_lightning_tpu.mpmd.partition import MpmdSpec
+
+        cfg = self.config
+        block = self._block
+
+        def embed_fn(params, x):
+            T = x.shape[1]
+            return (params["wte"][x] + params["wpe"][:T]).astype(cfg.dtype)
+
+        def stage_fn(layer_params, h):
+            out = block.apply({"params": layer_params}, h, True)
+            return out
+
+        if cfg.remat:
+            stage_fn = jax.checkpoint(stage_fn)
+
+        def head_loss_fn(params, h, batch):
+            _, y = batch
+            h = _layernorm(h, params["ln_f"]["scale"],
+                           params["ln_f"]["bias"])
+            logits = jnp.einsum(
+                "btc,vc->btv", h,
+                params["wte"].astype(cfg.dtype)).astype(jnp.float32)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        return MpmdSpec(n_layers=cfg.n_layer, embed_fn=embed_fn,
+                        stage_fn=stage_fn, head_loss_fn=head_loss_fn,
+                        stacked_key="blocks",
+                        embed_keys=("wte", "wpe"),
+                        head_keys=("ln_f",), tied_keys=("wte",))
+
     # -- compute ---------------------------------------------------------
 
     def _forward(self, params, idx):

@@ -1,0 +1,29 @@
+"""MPMD pipeline plane: per-stage programs over DCN.
+
+Pipeline-parallel training as N cooperating per-stage programs instead
+of one SPMD program (ROADMAP item 1; "Scaling Deep Learning Training
+with MPMD Pipeline Parallelism", PAPERS.md 2412.14374):
+
+- ``partition.py`` — contiguous layer slices from an explicit cut list
+  or the planner's scored choice; per-chunk params + fwd/bwd programs
+  whose arguments are ONLY that chunk's layers (each host compiles a
+  fraction of the model, through the persistent compile cache);
+- ``channel.py`` — stage(i)↔stage(i+1) activation/activation-grad
+  exchange with the comm plane's fp8/int4/int8/bf16 codecs + error
+  feedback on the payloads, out-of-order-safe mailboxes, dead-peer
+  timeouts that name the stage;
+- ``schedule.py`` — GPipe and 1F1B (auto-interleaved over virtual
+  chunks) as driver-side microbatch schedules with a bubble
+  simulator;
+- ``engine.py`` — the runtime: in-process proxy mode and per-stage
+  cluster actors over the worker↔worker peer channel;
+- ``strategy.py`` — ``Trainer(strategy="mpmd")`` + ``RLT_MPMD*`` env
+  knobs (config.py).
+"""
+
+from ray_lightning_tpu.mpmd.config import MpmdConfig  # noqa: F401
+from ray_lightning_tpu.mpmd.strategy import (  # noqa: F401
+    MpmdPipelineStrategy,
+)
+
+__all__ = ["MpmdConfig", "MpmdPipelineStrategy"]

@@ -1,0 +1,192 @@
+"""Point-to-point activation channel between pipeline stages.
+
+Stage(i) ↔ stage(i+1) exchange of activations and activation-grads —
+the one data path of the MPMD runtime that crosses hosts (everything
+else is control RPC).  Three layers:
+
+- **Mailbox**: a thread-safe tag-addressed store.  Tags are
+  ``(kind, chunk, mb, step)`` tuples, so *out-of-order delivery is
+  harmless by construction* — a receive blocks on ITS tag and takes
+  whatever order the payloads arrived in.  A receive that outlives
+  ``timeout_s`` raises :class:`PeerTimeout` naming the waiting stage/
+  rank and the missing payload instead of hanging the fleet (the
+  dead-peer contract tests/test_mpmd.py pins).
+- **ChannelCodec**: the comm plane's fp8/int4/int8/bf16 codecs
+  (comm/quant.py ``compress_cast``) applied per payload, with the
+  EQuARX error-feedback residual carried PER (kind, mb) SLOT across
+  optimizer steps — encode adds the slot's residual before
+  quantizing and stores the new quantization error; the residual tree
+  rides the owning stage's optimizer state (engine) so it checkpoints
+  and restores with it.  ``codec="none"`` is a passthrough.
+- **Transports**: :class:`InProcessChannel` (shared mailboxes — the
+  single-process proxy mode) and :class:`PeerChannel` (the cluster
+  backends' worker↔worker peer frames next to the worker→driver
+  queue: builtin backend routes ``peer`` frames through the driver's
+  socket fan-in, Ray delivers via a concurrent actor method —
+  cluster/backend.py ``peer_send`` / worker_state mailbox).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ray_lightning_tpu.cluster.peer import (  # noqa: F401 - re-export
+    Mailbox,
+    PeerTimeout,
+)
+
+
+def payload_tag(kind: str, chunk: int, mb: int, step: int) -> tuple:
+    return (kind, int(chunk), int(mb), int(step))
+
+
+# -- codec ------------------------------------------------------------------
+
+
+class ChannelCodec:
+    """Per-link payload codec with per-slot error feedback.
+
+    One instance per SENDING side of a link.  ``encode(slot, x)``
+    returns the wire dict; ``decode(wire)`` reverses it on the
+    receiver.  With ``error_feedback`` the slot's residual (same shape
+    as the payload, fp32) persists across steps: the signal actually
+    quantized is ``x + residual`` and the new residual is the
+    quantization error — the comm plane's ``CommState`` contract on
+    the activation path.  ``residuals`` is a plain dict pytree the
+    engine stores inside the stage's optimizer state, so it
+    checkpoints/restores with the stage and ``state_dict`` round-trips
+    it (tests/test_mpmd.py).
+    """
+
+    def __init__(self, mode: str = "none", block_size: int = 64,
+                 error_feedback: bool = True):
+        self.mode = mode
+        self.block_size = block_size
+        self.error_feedback = error_feedback and mode not in ("none",
+                                                              "bf16")
+        self.residuals: dict = {}
+
+    def encode(self, slot: tuple, x) -> dict:
+        import jax.numpy as jnp
+
+        arr = np.asarray(x)
+        if self.mode == "none":
+            return {"mode": "none", "q": arr}
+        from ray_lightning_tpu.comm.quant import compress_cast
+        val = jnp.asarray(arr, jnp.float32)
+        if val.shape[-1] % self.block_size:
+            raise ValueError(
+                f"activation trailing dim {val.shape[-1]} not a "
+                f"multiple of the codec block size {self.block_size}")
+        if self.error_feedback:
+            r = self.residuals.get(slot)
+            if r is not None:
+                val = val + jnp.asarray(r)
+        q, scale = compress_cast(val, self.mode, self.block_size)
+        wire = {"mode": self.mode, "q": np.asarray(q),
+                "block_size": self.block_size,
+                "shape": arr.shape, "dtype": str(arr.dtype)}
+        if scale is not None:
+            wire["scale"] = np.asarray(scale)
+        if self.error_feedback:
+            from ray_lightning_tpu.comm.quant import decompress_cast
+            self.residuals[slot] = np.asarray(
+                val - decompress_cast(q, scale, self.mode,
+                                      self.block_size))
+        return wire
+
+    @staticmethod
+    def decode(wire: dict):
+        import jax.numpy as jnp
+
+        if wire["mode"] == "none":
+            return jnp.asarray(wire["q"])
+        from ray_lightning_tpu.comm.quant import decompress_cast
+        out = decompress_cast(jnp.asarray(wire["q"]),
+                              (jnp.asarray(wire["scale"])
+                               if "scale" in wire else None),
+                              wire["mode"], wire.get("block_size", 64))
+        return out.astype(wire["dtype"]).reshape(wire["shape"])
+
+    # -- persistence (residual rides the stage opt state) ----------------
+
+    def state_dict(self) -> dict:
+        return {"/".join(map(str, k)): v
+                for k, v in self.residuals.items()}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.residuals = {}
+        for key, v in (state or {}).items():
+            kind, chunk, mb, step = key.split("/")
+            self.residuals[(kind, int(chunk), int(mb), int(step))] = v
+
+
+def make_codec(config) -> ChannelCodec:
+    """Codec for one link under an :class:`MpmdConfig`."""
+    return ChannelCodec(mode=config.codec, block_size=config.block_size,
+                        error_feedback=config.error_feedback)
+
+
+def ef_slot(kind: str, mb: int) -> tuple:
+    """Error-feedback residual slot: per (direction, microbatch) — the
+    payload at a fixed slot is the quantity whose step-over-step error
+    the residual accumulates (chunk/step stay out of the key so the
+    residual persists across steps)."""
+    return (kind, 0, mb, 0)
+
+
+# -- transports -------------------------------------------------------------
+
+
+class InProcessChannel:
+    """All chunks in one process: one shared mailbox per chunk."""
+
+    def __init__(self, n_chunks: int, timeout_s: float = 120.0):
+        self.timeout_s = timeout_s
+        self._boxes = [Mailbox() for _ in range(n_chunks)]
+
+    def send(self, dst_chunk: int, tag: tuple, wire: Any) -> None:
+        self._boxes[dst_chunk].put(tag, wire)
+
+    def recv(self, chunk: int, tag: tuple, *, who: str = "",
+             src: str = "peer") -> Any:
+        return self._boxes[chunk].take(tag, self.timeout_s,
+                                       who=who or f"chunk {chunk}",
+                                       src=src)
+
+
+class PeerChannel:
+    """Worker-side transport over the cluster backends' peer frames.
+
+    Each stage actor owns one :class:`Mailbox`; incoming peer items
+    (``{"tag": ..., "wire": ...}``) land there via
+    ``worker_state.peer_push`` — routed by the builtin backend's
+    driver socket fan-in, or delivered by Ray through the actor's
+    concurrent ``__rlt_peer_deliver__`` method.  ``peers`` maps chunk
+    index → actor name; sends go through ``worker_state.peer_send``.
+    """
+
+    def __init__(self, my_chunks, peers: dict, timeout_s: float = 120.0,
+                 rank: Optional[int] = None):
+        self.my_chunks = tuple(my_chunks)
+        self.peers = dict(peers)
+        self.timeout_s = timeout_s
+        self.rank = rank
+        from ray_lightning_tpu.cluster import worker_state
+        self.mailbox = worker_state.peer_mailbox()
+
+    def send(self, dst_chunk: int, tag: tuple, wire: Any) -> None:
+        if dst_chunk in self.my_chunks:
+            self.mailbox.put(tag, wire)
+            return
+        from ray_lightning_tpu.cluster import worker_state
+        worker_state.peer_send(self.peers[dst_chunk],
+                               {"tag": tag, "wire": wire})
+
+    def recv(self, chunk: int, tag: tuple, *, who: str = "",
+             src: str = "peer") -> Any:
+        who = who or (f"stage rank {self.rank} (chunk {chunk})"
+                      if self.rank is not None else f"chunk {chunk}")
+        return self.mailbox.take(tag, self.timeout_s, who=who, src=src)

@@ -1,0 +1,185 @@
+"""MPMD pipeline configuration (``Trainer(strategy="mpmd")`` knobs).
+
+``MpmdConfig`` is the frozen, picklable settings object of the MPMD
+plane, following the ``CommPolicy`` / ``PlanConfig`` construction
+pattern (first match wins):
+
+- ``Trainer(strategy=MpmdPipelineStrategy(MpmdConfig(...)))`` — full
+  control;
+- ``Trainer(strategy="mpmd")`` — env knobs, read at resolution time:
+  ``RLT_MPMD_STAGES``, ``RLT_MPMD_CUTS`` (comma-separated ascending
+  layer boundaries; empty = planner-scored even split),
+  ``RLT_MPMD_SCHEDULE`` (``gpipe``/``1f1b``), ``RLT_MPMD_MICRO``,
+  ``RLT_MPMD_VIRTUAL`` (0 = auto interleave when layers allow),
+  ``RLT_MPMD_CODEC`` (``none``/``bf16``/``int8``/``fp8``/``int4`` —
+  the comm plane's codec menu applied to the activation payloads),
+  ``RLT_MPMD_BLOCK``, ``RLT_MPMD_EF``, ``RLT_MPMD_ACTORS``,
+  ``RLT_MPMD_TIMEOUT_S``.
+
+The resolved config pickles driver→worker with the strategy and
+round-trips through ``worker_env()`` like the comm/compile/elastic/plan
+knobs do (plugins/xla.py), so worker-side tooling consulting
+``RLT_MPMD*`` stays consistent with the driver's resolution.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+#: codec menu for the activation channel — ``none`` plus everything the
+#: comm plane's ``compress_cast`` dispatch accepts (comm/quant.py)
+VALID_CODECS = ("none", "bf16", "int8", "fp8", "int4")
+VALID_SCHEDULES = ("gpipe", "1f1b")
+
+ENV_STAGES = "RLT_MPMD_STAGES"
+ENV_CUTS = "RLT_MPMD_CUTS"
+ENV_SCHEDULE = "RLT_MPMD_SCHEDULE"
+ENV_MICRO = "RLT_MPMD_MICRO"
+ENV_VIRTUAL = "RLT_MPMD_VIRTUAL"
+ENV_CODEC = "RLT_MPMD_CODEC"
+ENV_BLOCK = "RLT_MPMD_BLOCK"
+ENV_EF = "RLT_MPMD_EF"
+ENV_ACTORS = "RLT_MPMD_ACTORS"
+ENV_TIMEOUT = "RLT_MPMD_TIMEOUT_S"
+ENV_KNOBS = (ENV_STAGES, ENV_CUTS, ENV_SCHEDULE, ENV_MICRO, ENV_VIRTUAL,
+             ENV_CODEC, ENV_BLOCK, ENV_EF, ENV_ACTORS, ENV_TIMEOUT)
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name, "").strip()
+    if raw in ("0", "false", "False"):
+        return False
+    if raw in ("1", "true", "True"):
+        return True
+    return default
+
+
+@dataclasses.dataclass(frozen=True)
+class MpmdConfig:
+    """How the MPMD pipeline runs.
+
+    stages: number of cooperating per-stage programs (>= 2).
+    cuts: ascending layer boundaries between stages (``(2,)`` on 4
+        layers = slices [0:2) and [2:4)).  ``None`` = let the stage
+        partitioner pick, scoring every contiguous cut with the
+        planner's per-link ``_dcn`` byte attribution
+        (mpmd/partition.py choose_cuts).
+    schedule: driver-side microbatch schedule — ``"gpipe"`` (all
+        forwards, then all backwards) or ``"1f1b"`` (one-forward-
+        one-backward steady state; interleaves over virtual stage
+        chunks when the layer count allows — see mpmd/schedule.py for
+        why PLAIN 1F1B analytically ties GPipe's bubble and
+        interleaving is what buys it down).
+    microbatches: microbatches per optimizer step (batch must divide).
+    virtual: virtual chunks per stage for the interleaved 1F1B
+        schedule.  ``0`` = auto (2 when every stage slice splits
+        evenly and the schedule is 1f1b, else 1); GPipe always runs
+        un-interleaved.
+    codec: wire format of the stage-boundary activation / activation-
+        grad payloads (comm/quant.py codecs).  ``"none"`` ships the
+        residency dtype untouched.
+    block_size: codec scale-block length (must divide the trailing
+        activation dim; even for int4).
+    error_feedback: carry the per-link quantization residual across
+        steps and re-inject it before encoding (the comm plane's EF
+        machinery applied to the activation path); the residual rides
+        the stage's optimizer state and checkpoints with it.
+    actors: run each stage as a cluster-backend actor exchanging
+        activations over the worker↔worker peer channel (the true
+        MPMD-over-DCN shape).  ``False`` (default) runs the stages
+        in-process — same programs, same schedule, same channel codec,
+        one process (the CPU-proxy mode benches and tests use).
+    timeout_s: dead-peer bound — a channel receive that waits longer
+        raises naming the stage/rank/microbatch instead of hanging.
+    """
+
+    stages: int = 2
+    cuts: Optional[tuple] = None
+    schedule: str = "1f1b"
+    microbatches: int = 4
+    virtual: int = 0
+    codec: str = "none"
+    block_size: int = 64
+    error_feedback: bool = True
+    actors: bool = False
+    timeout_s: float = 120.0
+
+    def __post_init__(self):
+        if self.stages < 2:
+            raise ValueError(
+                f"mpmd stages must be >= 2 (got {self.stages}); a "
+                f"single stage is just the sequential model")
+        if self.schedule not in VALID_SCHEDULES:
+            raise ValueError(f"mpmd schedule {self.schedule!r}; "
+                             f"options: {VALID_SCHEDULES}")
+        if self.codec not in VALID_CODECS:
+            raise ValueError(f"mpmd codec {self.codec!r}; "
+                             f"options: {VALID_CODECS}")
+        if self.microbatches < 1:
+            raise ValueError("mpmd microbatches must be >= 1")
+        if self.virtual < 0:
+            raise ValueError("mpmd virtual must be >= 0 (0 = auto)")
+        if self.block_size <= 0:
+            raise ValueError("mpmd block_size must be positive")
+        if self.codec == "int4" and self.block_size % 2:
+            raise ValueError("mpmd int4 needs an even block_size")
+        if self.timeout_s <= 0:
+            raise ValueError("mpmd timeout_s must be positive")
+        if self.cuts is not None:
+            cuts = tuple(int(c) for c in self.cuts)
+            if list(cuts) != sorted(set(cuts)) or any(c <= 0 for c in cuts):
+                raise ValueError(
+                    f"mpmd cuts must be strictly ascending positive "
+                    f"layer boundaries, got {cuts}")
+            if len(cuts) != self.stages - 1:
+                raise ValueError(
+                    f"mpmd cuts {cuts} define {len(cuts) + 1} stages, "
+                    f"config says {self.stages}")
+            object.__setattr__(self, "cuts", cuts)
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def resolve(cls, value=None) -> "MpmdConfig":
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, dict):
+            return cls(**value)
+        if value is not None:
+            raise TypeError(f"bad mpmd config: {value!r}")
+        cuts_raw = os.environ.get(ENV_CUTS, "").strip()
+        cuts = tuple(int(c) for c in cuts_raw.split(",") if c) or None
+        return cls(
+            stages=int(os.environ.get(ENV_STAGES, "2")),
+            cuts=cuts,
+            schedule=os.environ.get(ENV_SCHEDULE, "1f1b").strip() or "1f1b",
+            microbatches=int(os.environ.get(ENV_MICRO, "4")),
+            virtual=int(os.environ.get(ENV_VIRTUAL, "0")),
+            codec=os.environ.get(ENV_CODEC, "none").strip() or "none",
+            block_size=int(os.environ.get(ENV_BLOCK, "64")),
+            error_feedback=_env_flag(ENV_EF, True),
+            actors=_env_flag(ENV_ACTORS, False),
+            timeout_s=float(os.environ.get(ENV_TIMEOUT, "120")),
+        )
+
+    # -- env round-trip --------------------------------------------------
+
+    def worker_env(self) -> dict:
+        """Env mapping reproducing this config via :meth:`resolve` in a
+        worker process (plugins/xla.py ships it like RLT_COMM*)."""
+        env = {
+            ENV_STAGES: str(self.stages),
+            ENV_SCHEDULE: self.schedule,
+            ENV_MICRO: str(self.microbatches),
+            ENV_VIRTUAL: str(self.virtual),
+            ENV_CODEC: self.codec,
+            ENV_BLOCK: str(self.block_size),
+            ENV_EF: "1" if self.error_feedback else "0",
+            ENV_ACTORS: "1" if self.actors else "0",
+            ENV_TIMEOUT: repr(self.timeout_s),
+        }
+        if self.cuts is not None:
+            env[ENV_CUTS] = ",".join(str(c) for c in self.cuts)
+        return env

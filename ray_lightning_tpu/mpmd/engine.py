@@ -1,0 +1,690 @@
+"""MPMD pipeline runtime: N cooperating per-stage programs.
+
+The inversion vs parallel/pipeline.py: there the whole pipeline is ONE
+SPMD program (every host traces and compiles the full model, the GPipe
+schedule is frozen at trace time); here each chunk compiles ONLY its
+own layer slice (through the active persistent compile cache — much
+smaller programs, so the cache win multiplies) and a DRIVER-side
+schedule (mpmd/schedule.py) decides the microbatch order — orders SPMD
+tracing cannot express.  Activations/activation-grads cross chunk
+boundaries over the activation channel (mpmd/channel.py) with the comm
+plane's codecs optionally on the wire.
+
+Two execution shapes, same programs, same schedule, same channel:
+
+- **in-process** (default): every chunk lives in this process and ops
+  execute serially in the schedule's dependency order — the CPU-proxy
+  mode (bubble fractions are therefore SIMULATED by replaying the
+  schedule under measured per-op seconds, the same traced-model
+  discipline the SPMD pipeline's byte accounting uses; real-fabric
+  wall numbers are the ROADMAP follow-on).
+- **actors** (``MpmdConfig(actors=True)``): one cluster-backend actor
+  per stage rank, each compiling only its chunks and blocking on peer
+  channel receives — the true MPMD-over-DCN shape; one RPC per stage
+  per step.
+
+Tied weights (GPT's ``wte``): the last chunk holds a mirror for the
+head; its gradient ships to the owning chunk 0 over the channel before
+the optimizer step and the updated value ships back after — the
+Megatron tied-embedding exchange, here as ordinary channel traffic.
+
+Per-op spans (``mpmd_fwd``/``mpmd_bwd`` with stage/mb attrs) ride the
+trace plane; the bubble/compile/byte summary lands on
+``trainer._mpmd_report`` for the bench and tests.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_lightning_tpu.mpmd import channel as chan
+from ray_lightning_tpu.mpmd import partition as part_mod
+from ray_lightning_tpu.mpmd import schedule as sched_mod
+from ray_lightning_tpu.telemetry import counter as _tcounter, span
+from ray_lightning_tpu.telemetry import metrics as _metrics
+
+_log = logging.getLogger(__name__)
+
+
+def _micro_split(batch, n_micro: int):
+    """Split every array leaf's leading dim into ``n_micro`` slices."""
+    leaves = jax.tree_util.tree_leaves(batch)
+    b = leaves[0].shape[0]
+    if b % n_micro:
+        raise ValueError(
+            f"batch size {b} does not divide into {n_micro} "
+            f"microbatches (RLT_MPMD_MICRO)")
+    mb = b // n_micro
+    return [jax.tree_util.tree_map(lambda x: x[m * mb:(m + 1) * mb],
+                                   batch)
+            for m in range(n_micro)]
+
+
+class ChunkRunner:
+    """One chunk's live state + program dispatch (both exec shapes)."""
+
+    def __init__(self, chunk: int, n_chunks: int, partition, programs,
+                 params, tx, config, channel, rank: Optional[int] = None):
+        self.chunk = chunk
+        self.n_chunks = n_chunks
+        self.partition = partition
+        self.programs = programs
+        self.params = params
+        self.tx = tx
+        self.tx_state = tx.init(params)
+        self.config = config
+        self.channel = channel
+        self.rank = rank if rank is not None else chunk
+        self.codec = chan.make_codec(config)
+        self.stash: dict = {}      # mb -> (input activation, batch|None)
+        self.acc = None            # accumulated dparams
+        self.losses: list = []
+        self.sent_bytes = 0
+        self._apply = jax.jit(self._apply_fn)
+
+    @property
+    def opt_state(self) -> dict:
+        """Optimizer state as stored/checkpointed: the channel codec's
+        error-feedback residuals ride NEXT TO the tx state — the comm
+        plane's CommState pattern applied to the activation path."""
+        return {"tx": self.tx_state,
+                "channel_ef": self.codec.state_dict()}
+
+    def load_opt_state(self, state: dict) -> None:
+        self.tx_state = state["tx"]
+        self.codec.load_state_dict(state.get("channel_ef", {}))
+
+    @property
+    def is_first(self) -> bool:
+        return self.chunk == 0
+
+    @property
+    def is_last(self) -> bool:
+        return self.chunk == self.n_chunks - 1
+
+    def _who(self) -> str:
+        return f"stage rank {self.rank} (chunk {self.chunk})"
+
+    def _send(self, dst: int, kind: str, mb: int, step: int, x) -> None:
+        wire = self.codec.encode(chan.ef_slot(kind, mb), x)
+        self.sent_bytes += sum(
+            np.asarray(v).nbytes for v in wire.values()
+            if isinstance(v, np.ndarray))
+        self.channel.send(dst, chan.payload_tag(kind, dst, mb, step),
+                          wire)
+
+    def _recv(self, kind: str, mb: int, step: int, src: int):
+        wire = self.channel.recv(
+            self.chunk, chan.payload_tag(kind, self.chunk, mb, step),
+            who=self._who(), src=f"chunk {src}")
+        return chan.ChannelCodec.decode(wire)
+
+    def _send_raw(self, dst: int, kind: str, step: int, items) -> None:
+        """Codec-free control payloads (the tied-weight exchange ships
+        exact — quantizing a weight update would desynchronize the
+        mirror; the codec is an ACTIVATION-path tool)."""
+        items = [np.asarray(v) for v in items]
+        self.sent_bytes += sum(v.nbytes for v in items)
+        self.channel.send(dst, chan.payload_tag(kind, dst, 0, step),
+                          items)
+
+    def _recv_raw(self, kind: str, step: int, src: int):
+        return self.channel.recv(
+            self.chunk, chan.payload_tag(kind, self.chunk, 0, step),
+            who=self._who(), src=f"chunk {src}")
+
+    # -- schedule ops ----------------------------------------------------
+
+    def forward(self, mb: int, step: int, micro_batch=None) -> None:
+        with span("mpmd_fwd", stage=self.rank, chunk=self.chunk, mb=mb):
+            if self.is_first:
+                x = micro_batch[0] if isinstance(
+                    micro_batch, (tuple, list)) else micro_batch
+            else:
+                x = self._recv("fwd", mb, step, self.chunk - 1)
+            self.stash[mb] = (x, micro_batch if self.is_last else None)
+            if self.is_last:
+                loss = self.programs["fwd"](self.params, x, micro_batch)
+                self.losses.append(loss)
+            else:
+                h = self.programs["fwd"](self.params, x)
+                self._send(self.chunk + 1, "fwd", mb, step, h)
+
+    def backward(self, mb: int, step: int) -> None:
+        with span("mpmd_bwd", stage=self.rank, chunk=self.chunk, mb=mb):
+            x, batch = self.stash.pop(mb)
+            if self.is_last:
+                _, dp, dh = self.programs["bwd"](self.params, x, batch)
+                self._send(self.chunk - 1, "bwd", mb, step, dh)
+            elif self.is_first:
+                g = self._recv("bwd", mb, step, self.chunk + 1)
+                dp = self.programs["bwd"](self.params, x, g)
+            else:
+                g = self._recv("bwd", mb, step, self.chunk + 1)
+                dp, dx = self.programs["bwd"](self.params, x, g)
+                self._send(self.chunk - 1, "bwd", mb, step, dx)
+            self.acc = dp if self.acc is None else \
+                jax.tree_util.tree_map(jnp.add, self.acc, dp)
+
+    # -- step boundary ---------------------------------------------------
+
+    def _apply_fn(self, params, tx_state, acc):
+        import optax
+        grads = jax.tree_util.tree_map(
+            lambda g: g / self.config.microbatches, acc)
+        updates, new_tx = self.tx.update(grads, tx_state, params)
+        return optax.apply_updates(params, updates), new_tx
+
+    def exchange_tied_grads(self, step: int) -> None:
+        """Pre-apply: the head mirror's grads ship to the owner (chunk
+        0), which folds them into its accumulator — the full-model
+        tied gradient is the sum of both ends' contributions."""
+        tied = self.partition.spec.tied_keys
+        if not tied or self.n_chunks < 2:
+            return
+        if self.is_last:
+            self._send_raw(0, "tied_grad", step,
+                           [self.acc[k] for k in tied])
+        if self.is_first:
+            vals = self._recv_raw("tied_grad", step, self.n_chunks - 1)
+            for k, g in zip(tied, vals):
+                self.acc[k] = self.acc[k] + jnp.asarray(
+                    g, self.acc[k].dtype)
+
+    def apply(self) -> float:
+        self.params, self.tx_state = self._apply(
+            self.params, self.tx_state, self.acc)
+        self.acc = None
+        loss = (float(np.mean([np.asarray(v) for v in self.losses]))
+                if self.losses else 0.0)
+        self.losses = []
+        return loss
+
+    def broadcast_tied_values(self, step: int) -> None:
+        """Post-apply: the owner's freshly updated tied leaves
+        overwrite the head mirror, keeping the tie exact (the mirror's
+        own optimizer update is dead weight by construction)."""
+        tied = self.partition.spec.tied_keys
+        if not tied or self.n_chunks < 2:
+            return
+        if self.is_first:
+            self._send_raw(self.n_chunks - 1, "tied_val", step,
+                           [self.params[k] for k in tied])
+        if self.is_last:
+            vals = self._recv_raw("tied_val", step, 0)
+            self.params = dict(self.params)
+            for k, v in zip(tied, vals):
+                self.params[k] = jnp.asarray(v, self.params[k].dtype)
+
+
+# -- program compilation ----------------------------------------------------
+
+
+def compile_chunk(partition, chunk: int, h_aval, micro_aval,
+                  x_aval) -> "tuple[dict, dict]":
+    """Build + AOT-compile one chunk's fwd/bwd through the active
+    persistent cache (``lower().compile()`` writes the entry; the
+    first dispatch is a disk retrieval — the compile/aot.py contract).
+    Returns ``(programs, info)`` with per-program compile seconds and
+    HLO text sizes for the report and the per-stage-program tests."""
+    programs = part_mod.build_chunk_programs(partition, chunk)
+    pa = partition.chunk_param_avals[chunk]
+    first, last = chunk == 0, chunk == partition.n_chunks - 1
+    if last:
+        sigs = {"fwd": (pa, h_aval, micro_aval),
+                "bwd": (pa, h_aval, micro_aval)}
+    elif first:
+        sigs = {"fwd": (pa, x_aval), "bwd": (pa, x_aval, h_aval)}
+    else:
+        sigs = {"fwd": (pa, h_aval), "bwd": (pa, h_aval, h_aval)}
+    info: dict = {"compile_seconds": {}, "hlo_bytes": {}}
+    for name, args in sigs.items():
+        t0 = time.monotonic()
+        compiled = programs[name].lower(*args).compile()
+        dt = time.monotonic() - t0
+        info["compile_seconds"][name] = dt
+        try:
+            info["hlo_bytes"][name] = len(compiled.as_text())
+        except Exception:   # noqa: BLE001 - text dump optional
+            info["hlo_bytes"][name] = 0
+        _tcounter("mpmd_compile_seconds", dt, chunk=chunk, program=name)
+    return programs, info
+
+
+def _prepare(trainer, module, example_batch, config):
+    """Everything both exec shapes share: spec, planner-scored cuts,
+    partition, schedule, full init params (same rng derivation as the
+    SPMD trainer — parity by construction), per-chunk avals."""
+    if getattr(trainer, "gradient_clip_val", None):
+        raise ValueError(
+            "strategy='mpmd' does not support gradient_clip_val: "
+            "per-stage programs cannot take a global grad norm without "
+            "an extra cross-stage reduction (unimplemented)")
+    if getattr(trainer, "accumulate_grad_batches", 1) > 1:
+        raise ValueError(
+            "strategy='mpmd' expresses accumulation as its microbatch "
+            "schedule; set MpmdConfig.microbatches instead of "
+            "accumulate_grad_batches")
+    spec = part_mod.spec_of(module)
+    tx = trainer._configure_tx(module)
+
+    from ray_lightning_tpu.core.steps import build_init_fn
+    init_fn = build_init_fn(module, tx)
+    rng = jax.random.PRNGKey(
+        int(os.environ.get("RLT_GLOBAL_SEED", "0"))
+        if trainer.seed is None else trainer.seed)
+    state0 = jax.jit(init_fn)(rng, example_batch)
+    full_params = state0.params
+
+    micro = _micro_split(example_batch, config.microbatches)[0]
+    x0 = micro[0] if isinstance(micro, (tuple, list)) else micro
+    x_aval = jax.ShapeDtypeStruct(np.asarray(x0).shape,
+                                  np.asarray(x0).dtype)
+    micro_aval = jax.tree_util.tree_map(
+        lambda v: jax.ShapeDtypeStruct(np.asarray(v).shape,
+                                       np.asarray(v).dtype), micro)
+    embed_params = {k: full_params[k] for k in spec.embed_keys}
+    h_shape = jax.eval_shape(spec.embed_fn, embed_params, x_aval)
+    h_aval = jax.ShapeDtypeStruct(h_shape.shape, h_shape.dtype)
+
+    # planner-scored cuts: boundary activation bytes at the DCN link,
+    # stage balance as tie-breaker (mpmd/partition.py score_cuts)
+    layer_bytes = sum(
+        int(np.prod(v.shape[1:], dtype=np.int64)) * v.dtype.itemsize
+        for v in jax.tree_util.tree_leaves(full_params[spec.stacked_key]))
+    boundary_bytes = int(np.prod(h_aval.shape, dtype=np.int64)
+                         ) * h_aval.dtype.itemsize
+    cuts = part_mod.resolve_cuts(
+        spec.n_layers, config.stages, config.cuts,
+        layer_bytes=layer_bytes, boundary_bytes=boundary_bytes,
+        n_micro=config.microbatches, codec=config.codec,
+        block_size=config.block_size,
+        plan_config=getattr(trainer, "plan", None))
+
+    even = (tuple(spec.n_layers // config.stages * s
+                  for s in range(1, config.stages))
+            if spec.n_layers % config.stages == 0 else None)
+    lps = (spec.n_layers // config.stages
+           if even is not None and cuts == even else 1)
+    virtual = sched_mod.resolve_virtual(config.schedule, config.virtual,
+                                        lps, config.microbatches)
+    partition = part_mod.build_partition(spec, cuts, virtual)
+    partition.chunk_param_avals = [
+        jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype),
+            partition.chunk_params(full_params, c))
+        for c in range(partition.n_chunks)]
+    schedule = sched_mod.build_schedule(config.schedule, config.stages,
+                                        config.microbatches, virtual)
+    return (spec, tx, full_params, partition, schedule, cuts,
+            h_aval, micro_aval, x_aval, boundary_bytes)
+
+
+# -- in-process fit ---------------------------------------------------------
+
+
+def run_mpmd_fit(trainer, module, loaders, example_batch):
+    """The fit loop behind ``Trainer(strategy='mpmd')``.  Honors
+    max_steps / max_epochs / limit_train_batches and the train-loop
+    callback surface the harness and tests use.  Validation inside an
+    MPMD fit is not run — evaluate with a non-mpmd strategy (same
+    math: without a stage axis the model is a plain sequential
+    scan)."""
+    strategy = trainer.plugin.strategy
+    config = strategy.config
+    if config.actors:
+        return _run_actor_fit(trainer, module, loaders, example_batch,
+                              config)
+
+    (spec, tx, full_params, partition, schedule, cuts, h_aval,
+     micro_aval, x_aval, boundary_bytes) = _prepare(
+        trainer, module, example_batch, config)
+
+    channel = chan.InProcessChannel(partition.n_chunks,
+                                    timeout_s=config.timeout_s)
+    runners: list = []
+    compile_info: list = []
+    with span("compile"):
+        for c in range(partition.n_chunks):
+            programs, info = compile_chunk(partition, c, h_aval,
+                                           micro_aval, x_aval)
+            runners.append(ChunkRunner(
+                c, partition.n_chunks, partition, programs,
+                partition.chunk_params(full_params, c), tx, config,
+                channel, rank=schedule.rank_of(c)))
+            compile_info.append(info)
+
+    # metrics plane: the activation exchange is this strategy's per-step
+    # fabric traffic — charged per executed step like a strategy's
+    # declared collectives, all of it DCN (the links the cuts minimize)
+    act_bytes = part_mod.activation_wire_bytes(
+        boundary_bytes, partition.n_chunks - 1, config.microbatches,
+        codec=config.codec, block_size=config.block_size)
+    if _metrics.metrics_enabled():
+        _metrics.note_step_collectives(
+            {"activation_exchange_dcn": act_bytes}, dcn_bytes=act_bytes)
+
+    # dependency-feasible global order for serial in-process execution
+    exec_order = sorted(
+        schedule.ends, key=lambda op: (schedule.starts[op],
+                                       schedule.rank_of(op.chunk)))
+    op_times: dict = {}
+
+    def run_step(batch, step_idx: int) -> float:
+        micros = _micro_split(batch, config.microbatches)
+        for op in exec_order:
+            t0 = time.perf_counter()
+            if op.kind == "F":
+                runners[op.chunk].forward(op.mb, step_idx,
+                                          micros[op.mb])
+            else:
+                runners[op.chunk].backward(op.mb, step_idx)
+            dt = time.perf_counter() - t0
+            key = (op.chunk, op.kind)
+            op_times[key] = (dt if key not in op_times
+                             else 0.5 * op_times[key] + 0.5 * dt)
+        runners[-1].exchange_tied_grads(step_idx)
+        runners[0].exchange_tied_grads(step_idx)
+        losses = [r.apply() for r in runners]
+        runners[0].broadcast_tied_values(step_idx)
+        runners[-1].broadcast_tied_values(step_idx)
+        return losses[-1]
+
+    result = _drive_loop(trainer, module, loaders, run_step, config)
+
+    # bubble attribution: replay BOTH schedules under the measured
+    # per-op seconds (module docstring: simulated — the serial
+    # in-process proxy cannot exhibit real overlap).  GPipe is always
+    # the un-interleaved classic, so when this run executed v>1 chunks
+    # its replay needs STAGE-level times: a stage's op is the sum of
+    # its chunks' measured ops (chunks c, c+S, ... share rank c%S).
+    def _stage_times() -> dict:
+        agg: dict = {}
+        for (c, k), dt in op_times.items():
+            key = (c % config.stages, k)
+            agg[key] = agg.get(key, 0.0) + dt
+        return agg
+
+    bubbles = {}
+    for kind in ("gpipe", "1f1b"):
+        v = schedule.virtual if kind == "1f1b" else 1
+        s = sched_mod.build_schedule(kind, config.stages,
+                                     config.microbatches, v)
+        if op_times:
+            s = sched_mod.simulate(
+                s, op_times if v == schedule.virtual
+                else _stage_times())
+        bubbles[kind] = s.to_dict()
+        _tcounter("mpmd_bubble_fraction",
+                  bubbles[kind]["bubble_fraction"], schedule=kind)
+        reg = _metrics.get_registry()
+        if reg is not None:
+            # per-schedule simulated bubble seconds/step, attributable
+            # next to the step-time series
+            reg.gauge("rlt_mpmd_bubble_seconds").set(
+                bubbles[kind]["bubble_fraction"]
+                * bubbles[kind]["makespan"], schedule=kind)
+
+    merged = partition.merge_params([r.params for r in runners])
+    from ray_lightning_tpu.core.state import TrainState
+    trainer.state = TrainState.create(
+        merged, {}, {f"chunk{r.chunk}": r.opt_state for r in runners},
+        jax.random.PRNGKey(0))
+    trainer._mpmd_report = {
+        "mode": "in-process",
+        "stages": config.stages,
+        "virtual": schedule.virtual,
+        "cuts": list(cuts),
+        "schedule": config.schedule,
+        "microbatches": config.microbatches,
+        "codec": config.codec,
+        "per_stage_compile_seconds": [
+            round(sum(i["compile_seconds"].values()), 4)
+            for i in compile_info],
+        "per_stage_hlo_bytes": [dict(i["hlo_bytes"])
+                                for i in compile_info],
+        "per_stage_param_elements": [
+            partition.params_elements(r.params) for r in runners],
+        "bubble": bubbles,
+        "activation_bytes_per_step": part_mod.activation_wire_bytes(
+            boundary_bytes, partition.n_chunks - 1, config.microbatches,
+            codec=config.codec, block_size=config.block_size),
+        "sent_bytes_per_stage": [r.sent_bytes for r in runners],
+    }
+    return result
+
+
+def _drive_loop(trainer, module, loaders, run_step, config):
+    """Shared epoch/step loop + the callback surface for both exec
+    shapes (setup, on_train_epoch_start/end, on_train_batch_end,
+    on_train_end, teardown — what the bench harness and the tests'
+    tracking callbacks consume)."""
+    for cb in trainer.callbacks:
+        cb.setup(trainer, module, "fit")
+    try:
+        step_idx = 0
+        for epoch in range(trainer.max_epochs or 10**9):
+            trainer.current_epoch = epoch
+            if trainer.max_steps >= 0 and step_idx >= trainer.max_steps:
+                break
+            for cb in trainer.callbacks:
+                cb.on_train_epoch_start(trainer, module)
+            for i, batch in enumerate(loaders["train"]):
+                if trainer.limit_train_batches is not None \
+                        and i >= trainer.limit_train_batches:
+                    break
+                if trainer.max_steps >= 0 \
+                        and step_idx >= trainer.max_steps:
+                    break
+                t0 = time.monotonic()
+                with span("step", step=step_idx):
+                    loss = run_step(jax.tree_util.tree_map(
+                        np.asarray, batch), step_idx)
+                if trainer.time_to_first_step is None \
+                        and trainer._stage_t0 is not None:
+                    trainer.time_to_first_step = (time.monotonic()
+                                                  - trainer._stage_t0)
+                _metrics.on_step(time.monotonic() - t0, step=step_idx)
+                step_idx += 1
+                trainer.global_step = step_idx
+                trainer.callback_metrics["loss"] = loss
+                metrics = {"loss": np.float32(loss)}
+                for cb in trainer.callbacks:
+                    cb.on_train_batch_end(trainer, module, metrics,
+                                          batch, i)
+            for cb in trainer.callbacks:
+                cb.on_train_epoch_end(trainer, module)
+        for cb in trainer.callbacks:
+            cb.on_train_end(trainer, module)
+    finally:
+        for cb in trainer.callbacks:
+            cb.teardown(trainer, module, "fit")
+    return trainer
+
+
+# -- actor fit --------------------------------------------------------------
+
+
+class _ActorTrainerShim:
+    """The slice of Trainer that ``_prepare`` reads, worker-side."""
+
+    gradient_clip_val = None
+    accumulate_grad_batches = 1
+    plan = None
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+
+    def _configure_tx(self, module, grad_sync=None):
+        tx = module.configure_optimizers()
+        return tx["optimizer"] if isinstance(tx, dict) else tx
+
+
+class MpmdStageActor:
+    """One stage rank as a cluster-backend actor: builds ONLY its
+    chunks' programs (the per-stage compile the whole plane exists
+    for), executes its per-rank op queue per step, exchanging payloads
+    over the peer channel (cluster/backend.py).  One ``run_step`` RPC
+    per stage per step; first/last ranks receive the host batch,
+    middles run from the channel alone."""
+
+    def __init__(self, rank: int, module, config, peer_names,
+                 seed: int = 0):
+        self.rank = rank
+        self.config = config
+        self._module = module
+        self._peer_names = list(peer_names)
+        self._seed = seed
+        module.setup_model()
+
+    def setup(self, example_batch):
+        """Deferred heavy init (jax init + per-chunk compiles) so actor
+        construction stays cheap and failures carry call context."""
+        (spec, tx, full_params, partition, schedule, cuts, h_aval,
+         micro_aval, x_aval, _bb) = _prepare(
+            _ActorTrainerShim(self._seed), self._module,
+            example_batch, self.config)
+        self.partition, self.schedule = partition, schedule
+        my_chunks = [c for c in range(partition.n_chunks)
+                     if schedule.rank_of(c) == self.rank]
+        channel = chan.PeerChannel(
+            my_chunks,
+            {c: self._peer_names[schedule.rank_of(c)]
+             for c in range(partition.n_chunks)},
+            timeout_s=self.config.timeout_s, rank=self.rank)
+        self.runners = {}
+        info = {}
+        for c in my_chunks:
+            programs, ci = compile_chunk(partition, c, h_aval,
+                                         micro_aval, x_aval)
+            self.runners[c] = ChunkRunner(
+                c, partition.n_chunks, partition, programs,
+                partition.chunk_params(full_params, c), tx,
+                self.config, channel, rank=self.rank)
+            info[c] = ci
+        self.ops = self.schedule.ranks[self.rank]
+        return {"rank": self.rank, "chunks": my_chunks,
+                "cuts": list(cuts), "virtual": schedule.virtual,
+                "compile_seconds": {
+                    c: i["compile_seconds"] for c, i in info.items()},
+                "param_elements": {
+                    c: partition.params_elements(self.runners[c].params)
+                    for c in my_chunks}}
+
+    def run_step(self, step_idx: int, batch=None):
+        micros = (_micro_split(jax.tree_util.tree_map(np.asarray, batch),
+                               self.config.microbatches)
+                  if batch is not None else None)
+        for op in self.ops:
+            r = self.runners[op.chunk]
+            if op.kind == "F":
+                mbatch = (micros[op.mb] if micros is not None
+                          and (r.is_first or r.is_last) else None)
+                r.forward(op.mb, step_idx, mbatch)
+            else:
+                r.backward(op.mb, step_idx)
+        for r in self.runners.values():
+            r.exchange_tied_grads(step_idx)
+        losses = {c: r.apply() for c, r in self.runners.items()}
+        for r in self.runners.values():
+            r.broadcast_tied_values(step_idx)
+        last = self.partition.n_chunks - 1
+        return {"rank": self.rank, "loss": losses.get(last)}
+
+    def chunk_params(self):
+        """chunk -> host param tree (driver merges the full model)."""
+        return {c: jax.tree_util.tree_map(np.asarray, r.params)
+                for c, r in self.runners.items()}
+
+    def ping(self):
+        return self.rank
+
+    def __rlt_peer_deliver__(self, item):
+        """Ray-backend peer delivery (runs on a concurrent actor
+        thread — the driver creates stage actors with
+        max_concurrency >= 2; the builtin backend delivers via its
+        peer frames instead and never calls this)."""
+        from ray_lightning_tpu.cluster import worker_state
+        worker_state.peer_push(item)
+        return True
+
+
+def _run_actor_fit(trainer, module, loaders, example_batch, config):
+    """Driver side of the actor shape: one stage actor per rank over
+    the cluster backend, setup (each compiles only its own chunks),
+    then one ``run_step`` fan-out per optimizer step."""
+    import uuid
+
+    from ray_lightning_tpu.cluster.backend import get_backend
+
+    backend = get_backend()
+    run_tag = uuid.uuid4().hex[:8]
+    names = [f"rlt-mpmd-{os.getpid()}-{run_tag}-s{r}"
+             for r in range(config.stages)]
+    env = {"JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+           # rng lowering must match the driver's: stage actors re-run
+           # the same PRNGKey(seed) init, and a flag mismatch here
+           # would draw DIFFERENT (equally random) initial kernels
+           "JAX_THREEFRY_PARTITIONABLE":
+               str(bool(jax.config.jax_threefry_partitionable)).lower(),
+           **config.worker_env()}
+    seed = 0 if trainer.seed is None else trainer.seed
+    actors = []
+    try:
+        for r in range(config.stages):
+            actors.append(backend.create_actor(
+                MpmdStageActor, r, module, config, names, seed,
+                env=env, name=names[r], max_concurrency=2))
+        eb = jax.tree_util.tree_map(np.asarray, example_batch)
+        setup_info = [f.result(timeout=600)
+                      for f in [a.call("setup", eb) for a in actors]]
+        cuts = tuple(setup_info[0]["cuts"])
+        virtual = int(setup_info[0]["virtual"])
+
+        def run_step(batch, step_idx):
+            futs = [a.call("run_step", step_idx,
+                           batch if r in (0, config.stages - 1)
+                           else None)
+                    for r, a in enumerate(actors)]
+            out = [f.result(timeout=config.timeout_s * 4)
+                   for f in futs]
+            losses = [o["loss"] for o in out if o["loss"] is not None]
+            return float(losses[-1]) if losses else 0.0
+
+        result = _drive_loop(trainer, module, loaders, run_step, config)
+
+        chunk_params: dict = {}
+        for a in actors:
+            chunk_params.update(
+                a.call("chunk_params").result(timeout=600))
+        partition = part_mod.build_partition(part_mod.spec_of(module),
+                                             cuts, virtual)
+        merged = partition.merge_params(
+            [chunk_params[c] for c in sorted(chunk_params)])
+        from ray_lightning_tpu.core.state import TrainState
+        trainer.state = TrainState.create(merged, {}, {},
+                                          jax.random.PRNGKey(0))
+        trainer._mpmd_report = {
+            "mode": "actors",
+            "stages": config.stages,
+            "virtual": virtual,
+            "cuts": list(cuts),
+            "schedule": config.schedule,
+            "microbatches": config.microbatches,
+            "codec": config.codec,
+            "setup": setup_info,
+        }
+        return result
+    finally:
+        for a in actors:
+            try:
+                a.kill()
+            except Exception:   # noqa: BLE001 - teardown best-effort
+                pass

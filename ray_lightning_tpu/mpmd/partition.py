@@ -1,0 +1,320 @@
+"""Stage partitioner: contiguous layer slices → per-chunk params and
+per-chunk compiled programs.
+
+The module under MPMD describes itself once through
+``LightningModule.configure_mpmd()`` (core/module.py), returning an
+:class:`MpmdSpec` — three pure functions (embed / one-layer stage /
+head+loss) plus which top-level param keys belong to the embedding and
+head and which are *tied* across both ends (GPT's ``wte``).  From that
+and a cut list the partitioner builds, per chunk:
+
+- a **param slice**: the stacked-layer leaves' ``[cut_lo:cut_hi]``
+  rows, plus the embed keys on chunk 0, the head keys on the last
+  chunk, and a *mirror* of each tied key on the last chunk (forward
+  needs it there; its gradient is shipped back to the owner over the
+  channel and the updated value re-broadcast after the step — the
+  Megatron tied-embedding exchange, done here as channel traffic);
+- **fwd/bwd jitted programs** over exactly that slice.  Backward
+  recomputes the chunk forward under ``jax.vjp`` from the stashed
+  input activation, so no residuals cross program boundaries and each
+  program's arguments are only its own layers — the per-stage-programs
+  property the compile-cache/HLO assertions in tests/test_mpmd.py pin
+  (a chunk's program CANNOT compute layers whose params it never
+  receives).
+
+Cut selection: an explicit list wins; otherwise :func:`choose_cuts`
+enumerates every contiguous composition and scores each with the
+planner's cost primitives — boundary activation bytes (codec-aware,
+``comm.quant.payload_bytes``) at the ``_dcn`` link bandwidth
+(plan/cost.py ``link_gbps``) plus the compute imbalance of the largest
+stage — the stage-cut analog of the PR-8 candidate scoring.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class MpmdSpec:
+    """What a module tells the partitioner (``configure_mpmd``).
+
+    ``embed_fn(embed_params, x) -> h`` lifts the raw batch input into
+    the first activation; ``stage_fn(layer_params, h) -> h`` applies
+    ONE layer (the partitioner scans it over each chunk's stacked
+    slice); ``head_loss_fn(head_params, h, batch) -> loss`` finishes
+    the model and reduces to this microbatch's mean loss.  Param keys
+    are top-level names in the module's ``init_params`` tree:
+    ``stacked_key`` is the layer-stacked subtree (leading dim =
+    n_layers on every leaf), ``embed_keys``/``head_keys`` the ends'
+    extras, ``tied_keys`` ⊆ embed_keys the leaves the head ALSO reads.
+    """
+
+    n_layers: int
+    embed_fn: Callable[[Any, Any], Any]
+    stage_fn: Callable[[Any, Any], Any]
+    head_loss_fn: Callable[[Any, Any, Any], Any]
+    stacked_key: str = "blocks"
+    embed_keys: tuple = ("wte", "wpe")
+    head_keys: tuple = ("ln_f",)
+    tied_keys: tuple = ()
+
+    def __post_init__(self):
+        bad = [k for k in self.tied_keys if k not in self.embed_keys]
+        if bad:
+            raise ValueError(
+                f"tied_keys {bad} must be embed-owned (embed_keys is "
+                f"the ownership side of the tie)")
+
+
+def spec_of(module) -> MpmdSpec:
+    spec = module.configure_mpmd()
+    if not isinstance(spec, MpmdSpec):
+        raise TypeError(
+            f"{type(module).__name__}.configure_mpmd() must return an "
+            f"MpmdSpec, got {type(spec).__name__}")
+    return spec
+
+
+# -- cuts -------------------------------------------------------------------
+
+
+def enumerate_stage_cuts(n_layers: int, n_stages: int) -> "list[tuple]":
+    """Every contiguous split of ``n_layers`` into ``n_stages``
+    non-empty slices, as ascending boundary tuples (the planner's
+    stage-cut candidate space)."""
+    if n_stages > n_layers:
+        raise ValueError(
+            f"{n_layers} layers cannot split into {n_stages} non-empty "
+            f"stages")
+    return [tuple(c) for c in
+            itertools.combinations(range(1, n_layers), n_stages - 1)]
+
+
+def stage_slices(cuts: Sequence[int], n_layers: int) -> "list[tuple]":
+    bounds = [0, *cuts, n_layers]
+    return [(bounds[i], bounds[i + 1]) for i in range(len(bounds) - 1)]
+
+
+def score_cuts(cuts: Sequence[int], n_layers: int, *,
+               layer_bytes: int, boundary_bytes: int, n_micro: int,
+               codec: str = "none", block_size: int = 64,
+               plan_config=None, process_count: int = 2) -> tuple:
+    """Rank key for one cut list, smaller is better: (modeled step
+    comm seconds over the stage-boundary DCN links, largest stage's
+    layer count, label).  Uses the planner's own per-link attribution
+    — the ``activation_exchange_dcn`` op is scored at the DCN
+    bandwidth exactly like a strategy's ``_dcn``-suffixed declaration
+    in plan/cost.py — and the comm plane's codec byte model."""
+    from ray_lightning_tpu.comm.audit import bytes_to_seconds
+    from ray_lightning_tpu.plan.config import PlanConfig
+    from ray_lightning_tpu.plan.cost import link_gbps
+
+    config = plan_config or PlanConfig()
+    wire = activation_wire_bytes(boundary_bytes, len(cuts), n_micro,
+                                 codec=codec, block_size=block_size)
+    gbps = link_gbps("activation_exchange_dcn", config, process_count)
+    comm_s = bytes_to_seconds(wire, gbps)
+    sizes = [hi - lo for lo, hi in stage_slices(cuts, n_layers)]
+    return (comm_s, max(sizes) * layer_bytes, tuple(cuts))
+
+
+def activation_wire_bytes(boundary_bytes: int, n_boundaries: int,
+                          n_micro: int, *, codec: str = "none",
+                          block_size: int = 64) -> int:
+    """Bytes ONE optimizer step pushes across the stage-boundary links:
+    every boundary carries each microbatch's activation forward AND its
+    activation-grad backward, each at the codec's wire size
+    (``payload_bytes`` — the same model the comm plane's declarations
+    charge)."""
+    if codec == "none":
+        per = boundary_bytes
+    else:
+        from ray_lightning_tpu.comm.quant import payload_bytes
+        # boundary payloads travel as fp32-equivalent element counts
+        per = payload_bytes(max(1, boundary_bytes // 4), codec, block_size)
+    return 2 * n_boundaries * n_micro * per
+
+
+def resolve_cuts(n_layers: int, n_stages: int,
+                 cuts: Optional[Sequence[int]] = None, *,
+                 layer_bytes: int = 1, boundary_bytes: int = 1,
+                 n_micro: int = 1, codec: str = "none",
+                 block_size: int = 64, plan_config=None) -> tuple:
+    """Explicit ``cuts`` validated, or the planner's choice: the
+    best-scoring contiguous composition (uniform-layer models resolve
+    to the even split — the balance term — with the DCN term breaking
+    ties toward fewer boundary bytes)."""
+    if cuts is not None:
+        cuts = tuple(int(c) for c in cuts)
+        if len(cuts) != n_stages - 1 or list(cuts) != sorted(set(cuts)) \
+                or any(not 0 < c < n_layers for c in cuts):
+            raise ValueError(
+                f"cuts {cuts} do not split {n_layers} layers into "
+                f"{n_stages} non-empty contiguous stages")
+        return cuts
+    return min(
+        enumerate_stage_cuts(n_layers, n_stages),
+        key=lambda c: score_cuts(
+            c, n_layers, layer_bytes=layer_bytes,
+            boundary_bytes=boundary_bytes, n_micro=n_micro, codec=codec,
+            block_size=block_size, plan_config=plan_config))
+
+
+# -- per-chunk params -------------------------------------------------------
+
+
+@dataclasses.dataclass
+class StagePartition:
+    """Resolved chunk layout: slices + param selection/merge."""
+
+    spec: MpmdSpec
+    slices: list                   # chunk -> (lo, hi) layer bounds
+
+    @property
+    def n_chunks(self) -> int:
+        return len(self.slices)
+
+    def chunk_params(self, full_params: Any, chunk: int) -> dict:
+        """This chunk's param tree: its stacked-layer rows, plus the
+        ends' extras (tied keys mirrored onto the last chunk)."""
+        lo, hi = self.slices[chunk]
+        spec = self.spec
+        out: dict = {spec.stacked_key: jax.tree_util.tree_map(
+            lambda x: x[lo:hi], full_params[spec.stacked_key])}
+        if chunk == 0:
+            for k in spec.embed_keys:
+                out[k] = full_params[k]
+        if chunk == self.n_chunks - 1:
+            for k in spec.head_keys:
+                out[k] = full_params[k]
+            for k in spec.tied_keys:
+                out.setdefault(k, full_params[k])
+        return out
+
+    def merge_params(self, chunk_trees: Sequence[dict]) -> dict:
+        """Inverse of :meth:`chunk_params`: re-stack the layer rows in
+        cut order and take each extra key from its OWNER (embed keys —
+        tied mirrors on the last chunk are discarded; the engine keeps
+        them equal to the owner's value by re-broadcasting after every
+        step)."""
+        spec = self.spec
+        full: dict = {spec.stacked_key: jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=0),
+            *[t[spec.stacked_key] for t in chunk_trees])}
+        for k in spec.embed_keys:
+            full[k] = chunk_trees[0][k]
+        for k in spec.head_keys:
+            if k not in full:
+                full[k] = chunk_trees[-1][k]
+        return full
+
+    def tied_mirror_grads(self, last_chunk_grads: dict) -> dict:
+        return {k: last_chunk_grads[k] for k in self.spec.tied_keys}
+
+    def params_elements(self, chunk_tree: dict) -> int:
+        return sum(int(np.prod(x.shape, dtype=np.int64))
+                   for x in jax.tree_util.tree_leaves(chunk_tree))
+
+
+def build_partition(spec: MpmdSpec, cuts: Sequence[int],
+                    virtual: int = 1) -> StagePartition:
+    """Chunk layout.  ``virtual == 1``: one chunk per stage, sliced at
+    ``cuts``.  ``virtual > 1`` (interleaved 1F1B): the layer chain
+    splits into ``n_stages × virtual`` EQUAL contiguous chunks in
+    layer order, chunk c living on rank ``c % n_stages`` — the
+    Megatron interleaved placement, where each round of the forward
+    chain crosses every rank once.  Interleaving therefore requires
+    the even layout (custom cuts express per-STAGE imbalance, which
+    round-robin chunk placement cannot honor — rejected loudly)."""
+    n_stages = len(cuts) + 1
+    if virtual == 1:
+        return StagePartition(spec=spec,
+                              slices=list(stage_slices(cuts, spec.n_layers)))
+    n_chunks = n_stages * virtual
+    if spec.n_layers % n_chunks:
+        raise ValueError(
+            f"{spec.n_layers} layers do not split into {n_chunks} "
+            f"interleaved chunks ({n_stages} stages x {virtual} virtual)")
+    even = tuple(spec.n_layers // n_stages * s
+                 for s in range(1, n_stages))
+    if tuple(cuts) != even:
+        raise ValueError(
+            f"interleaved schedules need the even stage layout {even}, "
+            f"got cuts {tuple(cuts)} (drop virtual or the custom cuts)")
+    w = spec.n_layers // n_chunks
+    return StagePartition(
+        spec=spec, slices=[(c * w, (c + 1) * w) for c in range(n_chunks)])
+
+
+# -- per-chunk programs -----------------------------------------------------
+
+
+def _scan_layers(stage_fn, stacked, h):
+    def body(carry, p):
+        return stage_fn(p, carry), None
+    out, _ = jax.lax.scan(body, h, stacked)
+    return out
+
+
+def chunk_forward_fn(part: StagePartition, chunk: int) -> Callable:
+    """The pure forward math of one chunk (what both the fwd program
+    and the bwd recompute trace): chunk 0 takes the raw batch input,
+    the last chunk returns the microbatch loss, middles map h -> h."""
+    spec = part.spec
+    first = chunk == 0
+    last = chunk == part.n_chunks - 1
+
+    def fwd(params, x, batch=None):
+        h = spec.embed_fn(params, x) if first else x
+        h = _scan_layers(spec.stage_fn, params[spec.stacked_key], h)
+        if last:
+            return spec.head_loss_fn(params, h, batch)
+        return h
+
+    return fwd
+
+
+def build_chunk_programs(part: StagePartition, chunk: int) -> dict:
+    """Jitted fwd/bwd for one chunk (engine compiles them through the
+    active persistent cache via ``lower().compile()``).
+
+    Signatures (first/mid/last resolved by position in the chain):
+
+    - fwd: ``(params, x[, batch]) -> h | loss``
+    - bwd: ``(params, x, g) -> (dparams[, dx])`` for first/mid —
+      recompute-vjp from the stashed input; last:
+      ``(params, h, batch) -> (loss, dparams, dh)`` via value_and_grad
+      (cotangent 1.0 — the engine divides the accumulator by M at
+      apply time).
+    """
+    fwd = chunk_forward_fn(part, chunk)
+    first = chunk == 0
+    last = chunk == part.n_chunks - 1
+
+    if last:
+        def bwd(params, h, batch):
+            loss, (dp, dh) = jax.value_and_grad(
+                lambda p, hh: fwd(p, hh, batch), argnums=(0, 1))(params, h)
+            return loss, dp, dh
+
+        return {"fwd": jax.jit(fwd), "bwd": jax.jit(bwd)}
+
+    if first:
+        def bwd(params, x, g):
+            _, vjp = jax.vjp(lambda p: fwd(p, x), params)
+            (dp,) = vjp(g)
+            return dp
+    else:
+        def bwd(params, x, g):
+            _, vjp = jax.vjp(fwd, params, x)
+            dp, dx = vjp(g)
+            return dp, dx
+
+    return {"fwd": jax.jit(fwd), "bwd": jax.jit(bwd)}

@@ -1,0 +1,301 @@
+"""Driver-side microbatch schedules for the MPMD pipeline.
+
+A *schedule* is, per rank, an ordered list of ``(chunk, kind, mb)``
+ops (``kind`` ∈ {"F", "B"}) that the stage executors run in order,
+blocking on their channel receives.  Because the schedule is driver
+data — not an SPMD trace — it can express orders the compiled-in GPipe
+of parallel/pipeline.py cannot: the 1F1B steady state, interleaved
+virtual chunks, and (future) zero-bubble splits.
+
+Both built-in schedules come out of ONE greedy list-scheduler over the
+microbatch dependency DAG (``F(c, m)`` after ``F(c-1, m)``;
+``B(c, m)`` after ``F(c, m)`` and ``B(c+1, m)``), differing only in
+the op-priority rule:
+
+- ``gpipe``: forwards first — every rank runs all M forwards in
+  microbatch order, then all M backwards (the classic two-phase
+  schedule; what the SPMD pipeline compiles in).
+- ``1f1b``: backwards first — a ready backward always preempts a
+  forward, which reproduces the Megatron 1F1B warmup/steady-state
+  shape and bounds the in-flight (forwarded-but-not-backwarded)
+  activation stash at ``n_stages`` instead of GPipe's M.
+
+On the bubble: with one chunk per rank, PLAIN 1F1B's fill/drain
+bubble fraction analytically TIES GPipe's — (S-1)(tf+tb) of idle over
+a (M+S-1)(tf+tb) makespan for both; what 1F1B buys at v=1 is the
+bounded activation stash (``validate`` pins the depth).  The bubble
+win comes from *interleaving*: with ``virtual > 1`` chunks per rank
+the fill latency per chunk shrinks by ~v while the per-rank work is
+unchanged, so the 1f1b priority rule fills former bubble slots with
+other chunks' ops.  ``simulate`` makes both claims measurable (and
+tests/test_mpmd.py pins the tie AND the interleaved win).
+
+``simulate`` replays a schedule against per-op durations (defaults or
+measured, e.g. the engine's compiled-program timings) and returns
+per-rank busy/idle plus the op start/end times the engine re-emits as
+trace-plane bubble spans.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+#: default duration model: backward ≈ 2× forward (recompute + backprop)
+DEFAULT_TF = 1.0
+DEFAULT_TB = 2.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Op:
+    chunk: int
+    kind: str          # "F" | "B"
+    mb: int
+
+    def __repr__(self):
+        return f"{self.kind}{self.mb}c{self.chunk}"
+
+
+@dataclasses.dataclass
+class Schedule:
+    """One resolved schedule: rank-ordered op lists + its simulation."""
+
+    kind: str                      # "gpipe" | "1f1b"
+    n_stages: int
+    n_micro: int
+    virtual: int
+    ranks: list                    # rank -> [Op, ...] in execution order
+    starts: dict                   # Op -> start time (duration model)
+    ends: dict                     # Op -> end time
+    makespan: float
+    busy: list                     # rank -> busy time
+
+    @property
+    def n_chunks(self) -> int:
+        return self.n_stages * self.virtual
+
+    def rank_of(self, chunk: int) -> int:
+        return chunk % self.n_stages
+
+    @property
+    def bubble_fraction(self) -> float:
+        """Idle fraction of the fleet over the makespan: 1 - busy/(S·T).
+        The number the bench compares across schedules."""
+        total = self.n_stages * self.makespan
+        return 1.0 - sum(self.busy) / total if total > 0 else 0.0
+
+    def rank_bubble_fraction(self, rank: int) -> float:
+        return (1.0 - self.busy[rank] / self.makespan
+                if self.makespan > 0 else 0.0)
+
+    def to_dict(self) -> dict:
+        return {
+            "schedule": self.kind,
+            "stages": self.n_stages,
+            "microbatches": self.n_micro,
+            "virtual": self.virtual,
+            "makespan": round(self.makespan, 6),
+            "bubble_fraction": round(self.bubble_fraction, 4),
+            "rank_bubble_fractions": [
+                round(self.rank_bubble_fraction(r), 4)
+                for r in range(self.n_stages)],
+        }
+
+
+def _deps(op: Op, n_chunks: int):
+    if op.kind == "F":
+        if op.chunk > 0:
+            yield Op(op.chunk - 1, "F", op.mb)
+    else:
+        yield Op(op.chunk, "F", op.mb)
+        if op.chunk < n_chunks - 1:
+            yield Op(op.chunk + 1, "B", op.mb)
+
+
+def build_schedule(kind: str, n_stages: int, n_micro: int,
+                   virtual: int = 1,
+                   times: Optional[dict] = None) -> Schedule:
+    """Greedy list-schedule of the pipeline DAG under ``kind``'s
+    priority rule (module docstring).  ``times`` maps ``(chunk, "F"|
+    "B") -> seconds`` (defaults: tf=1, tb=2 split evenly over a rank's
+    chunks); pass the engine's measured per-program durations to get
+    the bubble numbers the bench reports."""
+    if kind not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown mpmd schedule {kind!r}")
+    if n_stages < 1 or n_micro < 1 or virtual < 1:
+        raise ValueError(
+            f"bad schedule shape: stages={n_stages} micro={n_micro} "
+            f"virtual={virtual}")
+    n_chunks = n_stages * virtual
+
+    def dur(chunk: int, k: str) -> float:
+        if times and (chunk, k) in times:
+            return float(times[(chunk, k)])
+        base = DEFAULT_TF if k == "F" else DEFAULT_TB
+        return base / virtual
+
+    pending = {Op(c, k, m) for c in range(n_chunks)
+               for k in ("F", "B") for m in range(n_micro)}
+    ends: dict = {}
+    starts: dict = {}
+    rank_free = [0.0] * n_stages
+    ranks: list = [[] for _ in range(n_stages)]
+    busy = [0.0] * n_stages
+
+    def ready(op: Op) -> bool:
+        return all(d in ends for d in _deps(op, n_chunks))
+
+    def ready_at(op: Op) -> float:
+        return max([ends[d] for d in _deps(op, n_chunks)], default=0.0)
+
+    # priority among a rank's ready ops: gpipe runs forwards first
+    # (all F before any B — the two-phase shape); 1f1b ALTERNATES —
+    # after a forward prefer a backward and vice versa (the literal
+    # one-F-one-B steady state; warmup falls out because no backward
+    # is ready yet, cooldown because no forward remains)
+    # 1f1b's defining constraint: a rank holds at most S·v in-flight
+    # (forwarded, not yet backwarded) microbatch-chunks — it IDLES
+    # rather than over-fill (a work-conserving greedy would drift to
+    # GPipe's M-deep stash during warmup).  GPipe is uncapped.
+    cap = n_stages * virtual if kind == "1f1b" else None
+    depth = [0] * n_stages
+    last_kind = ["B"] * n_stages   # so warmup prefers F
+
+    def prio(op: Op, rank: int) -> int:
+        if kind == "gpipe":
+            return 0 if op.kind == "F" else 1
+        return 0 if op.kind != last_kind[rank] else 1
+
+    while pending:
+        # earliest feasible (rank-free, deps-done, under-cap) op
+        # fleet-wide; ties broken by the schedule's priority rule then
+        # (mb, chunk) for determinism
+        best, best_key = None, None
+        for op in pending:
+            if not ready(op):
+                continue
+            rank = op.chunk % n_stages
+            if cap is not None and op.kind == "F" and depth[rank] >= cap:
+                continue
+            t = max(rank_free[rank], ready_at(op))
+            key = (t, prio(op, rank), op.mb, op.chunk)
+            if best_key is None or key < best_key:
+                best, best_key = op, key
+        if best is None:   # pragma: no cover - DAG is acyclic
+            raise RuntimeError("mpmd schedule deadlocked")
+        rank = best.chunk % n_stages
+        depth[rank] += 1 if best.kind == "F" else -1
+        last_kind[rank] = best.kind
+        t0 = best_key[0]
+        t1 = t0 + dur(best.chunk, best.kind)
+        starts[best] = t0
+        ends[best] = t1
+        rank_free[rank] = t1
+        busy[rank] += t1 - t0
+        ranks[rank].append(best)
+        pending.discard(best)
+
+    sched = Schedule(kind=kind, n_stages=n_stages, n_micro=n_micro,
+                     virtual=virtual, ranks=ranks, starts=starts,
+                     ends=ends, makespan=max(rank_free), busy=busy)
+    validate(sched)
+    return sched
+
+
+def resolve_virtual(schedule: str, virtual: int, layers_per_stage: int,
+                    n_micro: int) -> int:
+    """The interleave depth a config's ``virtual=0`` (auto) resolves
+    to: 2 when the schedule is 1f1b, every stage's layer slice splits
+    evenly and there are enough microbatches for the interleave to pay
+    (>= 2); GPipe and explicit values pass through (GPipe never
+    auto-interleaves — the classic schedule is the baseline the bench
+    diffs against)."""
+    if virtual > 0:
+        return virtual
+    if schedule == "1f1b" and layers_per_stage % 2 == 0 \
+            and layers_per_stage >= 2 and n_micro >= 2:
+        return 2
+    return 1
+
+
+def validate(sched: Schedule) -> None:
+    """Schedule invariants (also run by mpmd/selfcheck.py):
+
+    - every (chunk, mb) runs F exactly once and B exactly once, F
+      before B, in a valid dependency order rank-locally and globally;
+    - 1f1b only: the per-rank in-flight stash (microbatch-chunks
+      forwarded but not yet backwarded) never exceeds ``n_stages`` —
+      the bounded-memory property plain 1F1B exists for (GPipe's
+      stash legitimately reaches M).
+    """
+    n_chunks = sched.n_chunks
+    seen: dict = {}
+    order: dict = {}
+    i = 0
+    # global replay in simulated start order must respect every dep
+    for op in sorted(sched.ends, key=lambda o: (sched.starts[o],
+                                                o.chunk % sched.n_stages)):
+        order[op] = i
+        i += 1
+        seen[op] = seen.get(op, 0) + 1
+    for op in order:
+        for d in _deps(op, n_chunks):
+            if d not in order or order[d] >= order[op]:
+                raise AssertionError(f"schedule violates dep {d} -> {op}")
+    for c in range(n_chunks):
+        for m in range(sched.n_micro):
+            f, b = Op(c, "F", m), Op(c, "B", m)
+            if seen.get(f) != 1 or seen.get(b) != 1:
+                raise AssertionError(
+                    f"chunk {c} mb {m}: F×{seen.get(f)} B×{seen.get(b)}")
+            if sched.starts[b] < sched.ends[f]:
+                raise AssertionError(f"B before F for chunk {c} mb {m}")
+    if sched.kind == "1f1b":
+        for rank, ops in enumerate(sched.ranks):
+            depth = 0
+            for op in ops:
+                depth += 1 if op.kind == "F" else -1
+                if depth > sched.n_stages * sched.virtual:
+                    raise AssertionError(
+                        f"1f1b rank {rank} stash depth {depth} exceeds "
+                        f"{sched.n_stages * sched.virtual}")
+
+
+def simulate(sched: Schedule, times: dict) -> Schedule:
+    """Re-simulate an existing schedule's op ORDER under measured
+    per-op ``times`` ((chunk, kind) -> seconds): per-rank queues replay
+    in order, each op starting when its rank is free AND its deps'
+    re-timed ends have passed.  Returns a new Schedule with the same
+    order and updated starts/ends/busy/makespan — this is how the
+    engine turns measured program timings into the bubble fractions
+    the bench emits."""
+    n_chunks = sched.n_chunks
+    ends: dict = {}
+    starts: dict = {}
+    rank_free = [0.0] * sched.n_stages
+    busy = [0.0] * sched.n_stages
+    cursor = [0] * sched.n_stages
+    total = sum(len(ops) for ops in sched.ranks)
+    done = 0
+    while done < total:
+        progressed = False
+        for rank, ops in enumerate(sched.ranks):
+            while cursor[rank] < len(ops):
+                op = ops[cursor[rank]]
+                deps = list(_deps(op, n_chunks))
+                if any(d not in ends for d in deps):
+                    break
+                t0 = max([rank_free[rank]]
+                         + [ends[d] for d in deps])
+                t1 = t0 + float(times.get((op.chunk, op.kind), 1.0))
+                starts[op], ends[op] = t0, t1
+                rank_free[rank] = t1
+                busy[rank] += t1 - t0
+                cursor[rank] += 1
+                done += 1
+                progressed = True
+        if not progressed:   # pragma: no cover - validated schedules
+            raise RuntimeError("mpmd schedule replay deadlocked")
+    return dataclasses.replace(
+        sched, starts=starts, ends=ends,
+        makespan=max(rank_free), busy=busy)

@@ -1,0 +1,157 @@
+"""MPMD-plane selfcheck (wired into ``format.sh --check``).
+
+Asserts the invariants that don't need a training run:
+
+- schedule invariants on a grid of (stages, microbatches, virtual):
+  every microbatch's F before its B per chunk, dependency order holds
+  globally, 1F1B in-flight depth <= stages x virtual; plain 1F1B's
+  bubble TIES GPipe's (the analytic fact the schedule docstring pins)
+  while interleaved 1F1B beats it on >= 4 microbatches;
+- RLT_MPMD* env knobs round-trip through ``worker_env()`` →
+  ``resolve()`` unchanged, and invalid configs raise;
+- channel codec round-trip: exact for representable payloads, bounded
+  error + error-feedback residual update for fp8/int4, out-of-order
+  mailbox delivery, and the dead-peer timeout raising with the
+  stage/rank in the message;
+- stage-cut enumeration/resolution sanity (even split wins on uniform
+  layers; explicit bad cuts raise);
+- the MpmdPipelineStrategy resolves via ``Trainer(strategy="mpmd")``'s
+  registry path and declines the comm plane's gradient compression;
+- the mpmd metric name is on the telemetry lint surface.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _main(argv) -> int:   # noqa: ARG001 - argv kept for parity
+    import numpy as np
+
+    from ray_lightning_tpu.cluster.peer import Mailbox, PeerTimeout
+    from ray_lightning_tpu.mpmd import channel as chan
+    from ray_lightning_tpu.mpmd import partition as part
+    from ray_lightning_tpu.mpmd import schedule as sched
+    from ray_lightning_tpu.mpmd.config import MpmdConfig
+
+    problems: list[str] = []
+
+    # 1. schedule invariants + the bubble facts
+    for stages, micro, virtual in ((2, 4, 1), (2, 8, 2), (4, 8, 1),
+                                   (3, 6, 1), (2, 4, 2)):
+        for kind in ("gpipe", "1f1b"):
+            try:
+                s = sched.build_schedule(kind, stages, micro, virtual)
+                sched.validate(s)
+            except Exception as e:   # noqa: BLE001 - report, don't crash
+                problems.append(
+                    f"schedule {kind} S={stages} M={micro} v={virtual} "
+                    f"invalid: {e!r}")
+    try:
+        tie_g = sched.build_schedule("gpipe", 2, 4, 1).bubble_fraction
+        tie_f = sched.build_schedule("1f1b", 2, 4, 1).bubble_fraction
+        if abs(tie_g - tie_f) > 1e-9:
+            problems.append(
+                f"plain 1f1b bubble {tie_f} != gpipe {tie_g} (the "
+                f"documented analytic tie broke)")
+        inter = sched.build_schedule("1f1b", 2, 4, 2).bubble_fraction
+        if not inter < tie_g:
+            problems.append(
+                f"interleaved 1f1b bubble {inter} not below gpipe "
+                f"{tie_g} on 4 microbatches")
+    except Exception as e:   # noqa: BLE001
+        problems.append(f"bubble comparison failed: {e!r}")
+
+    # 2. env round-trip + validation
+    src = MpmdConfig(stages=3, cuts=(2, 5), schedule="gpipe",
+                     microbatches=6, virtual=1, codec="fp8",
+                     block_size=32, error_feedback=False, actors=True,
+                     timeout_s=7.5)
+    saved = {k: os.environ.get(k) for k in src.worker_env()}
+    os.environ.update(src.worker_env())
+    try:
+        if MpmdConfig.resolve(None) != src:
+            problems.append("RLT_MPMD* env round-trip changed the config")
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    for bad in (dict(stages=1), dict(schedule="zb"), dict(codec="int2"),
+                dict(codec="int4", block_size=33),
+                dict(stages=2, cuts=(1, 2))):
+        try:
+            MpmdConfig(**bad)
+            problems.append(f"MpmdConfig({bad}) should have raised")
+        except ValueError:
+            pass
+
+    # 3. channel: codec round-trip, EF residual, out-of-order, timeout
+    x = np.linspace(-1, 1, 256, dtype=np.float32).reshape(2, 128)
+    for mode in ("none", "bf16", "fp8", "int8", "int4"):
+        codec = chan.ChannelCodec(mode, block_size=64)
+        wire = codec.encode(("fwd", 0, 0, 0), x)
+        out = np.asarray(chan.ChannelCodec.decode(wire), np.float32)
+        tol = {"none": 0.0, "bf16": 0.01, "fp8": 0.08, "int8": 0.02,
+               "int4": 0.16}[mode]
+        if np.max(np.abs(out - x)) > tol:
+            problems.append(
+                f"codec {mode} round-trip error "
+                f"{np.max(np.abs(out - x)):.4f} > {tol}")
+        if codec.error_feedback:
+            if not codec.state_dict():
+                problems.append(f"codec {mode}: EF residual not carried")
+    box = Mailbox()
+    box.put(("fwd", 0, 1, 0), "late-first")
+    box.put(("fwd", 0, 0, 0), "early-second")
+    if box.take(("fwd", 0, 0, 0), 1.0) != "early-second":
+        problems.append("mailbox out-of-order take failed")
+    try:
+        box.take(("bwd", 0, 0, 0), 0.05, who="stage rank 1 (chunk 1)",
+                 src="chunk 0")
+        problems.append("dead-peer timeout did not raise")
+    except PeerTimeout as e:
+        if "stage rank 1" not in str(e):
+            problems.append(f"timeout error does not name the stage: {e}")
+
+    # 4. cuts
+    if part.resolve_cuts(8, 4, None) != (2, 4, 6):
+        problems.append("even split is not the default planner choice")
+    try:
+        part.resolve_cuts(4, 2, (5,))
+        problems.append("out-of-range cut should have raised")
+    except ValueError:
+        pass
+    if len(part.enumerate_stage_cuts(6, 3)) != 10:
+        problems.append("stage-cut enumeration count off (C(5,2)=10)")
+
+    # 5. strategy resolution + comm plane declines
+    from ray_lightning_tpu.parallel.strategy import (resolve_strategy,
+                                                     strategy_names)
+    strat = resolve_strategy("mpmd")
+    if getattr(strat, "name", "") != "mpmd":
+        problems.append("resolve_strategy('mpmd') did not resolve")
+    if "mpmd" not in strategy_names():
+        problems.append("'mpmd' missing from strategy_names()")
+    if strat.comm_compressible:
+        problems.append("mpmd must decline gradient compression")
+
+    # 6. metric name on the lint surface
+    from ray_lightning_tpu.telemetry.metrics import CORE_METRICS
+    if "rlt_mpmd_bubble_seconds" not in CORE_METRICS:
+        problems.append("rlt_mpmd_bubble_seconds missing from "
+                        "telemetry CORE_METRICS")
+
+    for p in problems:
+        print(f"mpmd selfcheck: {p}")
+    if not problems:
+        print("mpmd selfcheck: schedule invariants + bubble facts, env "
+              "round-trip, channel codec/EF/out-of-order/timeout, "
+              "stage cuts, strategy resolution, and metric names OK")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":   # pragma: no cover - exercised via format.sh
+    import sys
+    sys.exit(_main(sys.argv[1:]))

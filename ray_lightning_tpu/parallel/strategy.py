@@ -563,8 +563,10 @@ _STRATEGIES = {
 def strategy_names() -> list:
     """Every accepted ``Trainer(strategy=...)`` string, sorted (single
     source of truth for error messages, the planner inventory and the
-    README table)."""
-    return sorted(_STRATEGIES)
+    README table).  ``"mpmd"`` resolves lazily (the MPMD plane imports
+    this module) and stays OUT of ``_STRATEGIES`` — it is a routing
+    strategy the planner/comm planes never enumerate."""
+    return sorted([*_STRATEGIES, "mpmd"])
 
 
 def resolve_strategy(strategy: "str | ShardingStrategy | None") -> ShardingStrategy:
@@ -590,6 +592,11 @@ def resolve_strategy(strategy: "str | ShardingStrategy | None") -> ShardingStrat
                            (ray_lightning_tpu/plan/) picks strategy,
                            mesh, comm policy, donation and microbatch
                            from a cost model over the candidates above
+    ``"mpmd"``             ``MpmdPipelineStrategy`` — pipeline
+                           parallelism as N per-stage programs over
+                           DCN with driver-side schedules
+                           (ray_lightning_tpu/mpmd/; ``RLT_MPMD*``
+                           env knobs configure it)
     =====================  ===============================================
 
     Unknown names raise a ``ValueError`` listing the valid set.
@@ -600,6 +607,10 @@ def resolve_strategy(strategy: "str | ShardingStrategy | None") -> ShardingStrat
         return strategy
     if isinstance(strategy, str):
         key = strategy.lower()
+        if key == "mpmd":
+            from ray_lightning_tpu.mpmd.strategy import (
+                MpmdPipelineStrategy)
+            return MpmdPipelineStrategy()
         if key not in _STRATEGIES:
             raise ValueError(
                 f"Unknown strategy {strategy!r}; valid strategy names: "

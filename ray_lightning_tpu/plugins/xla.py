@@ -337,6 +337,12 @@ class RayXlaPlugin(ExecutionPlugin):
         # worker-side tooling consistent, and identical config on every
         # rank is what the planner's deterministic-winner contract needs
         base_env.update(trainer.plan.worker_env())
+        # MPMD knobs (RLT_MPMD* — mpmd/config.py): the strategy carries
+        # the resolved config; the env keeps worker-side tooling that
+        # consults RLT_MPMD* consistent with the driver's resolution
+        strat = getattr(self, "strategy", None)
+        if getattr(strat, "name", "") == "mpmd":
+            base_env.update(strat.config.worker_env())
         from ray_lightning_tpu.core import datacheck
         if datacheck.enabled():
             # driver-set RLT_DATA_CHECK=1 reaches workers explicitly

@@ -98,6 +98,9 @@ CORE_METRICS = (
     "rlt_snapshot_stall_seconds_total",
     "rlt_restarts_total",
     "rlt_worker_alive",
+    # MPMD plane (mpmd/engine.py): simulated bubble seconds/step per
+    # schedule, set once per fit from the measured per-op replay
+    "rlt_mpmd_bubble_seconds",
     # planner plane (core/trainer.py _resolve_auto_strategy gauges the
     # PlanReport counts after a strategy="auto" resolution)
     "rlt_plan_candidates_total",

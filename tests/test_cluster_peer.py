@@ -1,0 +1,100 @@
+"""Worker↔worker peer channel (cluster/peer.py + backend routing).
+
+The cluster backends' third data plane, added for the MPMD pipeline's
+activation exchange (tests/test_mpmd.py covers that consumer):
+tag-addressed mailboxes are out-of-order safe, dead-peer waits raise
+naming the waiter instead of hanging, and the builtin backend routes
+peer frames driver-side so a payload arrives WHILE the receiving
+actor's main thread is busy inside a call (the worker_main reader
+thread — without it the MPMD stage shape deadlocks).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import cloudpickle
+import pytest
+
+from ray_lightning_tpu.cluster.peer import Mailbox, PeerTimeout
+
+# the worker subprocess cannot import this test module by name; ship
+# the actor class by value instead (cloudpickle's documented seam)
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+def test_mailbox_out_of_order_delivery():
+    box = Mailbox()
+    tags = [("fwd", 1, m, 0) for m in range(4)]
+    for t in reversed(tags):          # arrive in reverse order
+        box.put(t, f"mb{t[2]}")
+    for m, t in enumerate(tags):      # consumed in schedule order
+        assert box.take(t, 1.0) == f"mb{m}"
+
+
+def test_dead_peer_timeout_names_waiter_and_payload():
+    box = Mailbox()
+    with pytest.raises(PeerTimeout) as ei:
+        box.take(("fwd", 1, 2, 7), 0.05,
+                 who="stage rank 1 (chunk 1)", src="chunk 0")
+    msg = str(ei.value)
+    assert "stage rank 1" in msg and "chunk 0" in msg
+    assert "'fwd'" in msg and "2" in msg   # what was missing, from whom
+
+
+class _PeerActor:
+    """Minimal peer-channel participant: blocks inside a call waiting
+    for a payload (proving delivery does not need the main thread),
+    or sends one to a named peer."""
+
+    def ping(self):
+        return "pong"
+
+    def wait_for(self, tag, timeout):
+        from ray_lightning_tpu.cluster import worker_state
+        return worker_state.peer_mailbox().take(
+            tuple(tag), timeout, who="receiver actor")
+
+    def send_to(self, dst_name, tag, payload):
+        from ray_lightning_tpu.cluster import worker_state
+        worker_state.peer_send(dst_name, {"tag": tuple(tag),
+                                          "wire": payload})
+        return True
+
+
+def test_local_backend_routes_peer_frames_mid_call():
+    """End-to-end over real subprocess actors: B's payload reaches A's
+    mailbox while A is BLOCKED inside ``wait_for`` — driver-side
+    routing (LocalBackend.peer_route) + the worker frame-reader thread
+    working together.  A second payload sent before anyone waits
+    proves buffering (out-of-order arrival is a mailbox no-op)."""
+    from ray_lightning_tpu.cluster.local import LocalBackend
+
+    backend = LocalBackend()
+    try:
+        a = backend.create_actor(_PeerActor, name="peer-a")
+        b = backend.create_actor(_PeerActor, name="peer-b")
+        assert a.call("ping").result(timeout=60) == "pong"
+        assert b.call("ping").result(timeout=60) == "pong"
+
+        # A blocks first; B delivers into the blocked call
+        fut = a.call("wait_for", ("fwd", 0, 0, 0), 30.0)
+        assert b.call("send_to", "peer-a", ("fwd", 0, 0, 0),
+                      {"h": [1, 2, 3]}).result(timeout=60)
+        assert fut.result(timeout=60) == {"h": [1, 2, 3]}
+
+        # buffered delivery: payload lands before the receive starts
+        assert a.call("send_to", "peer-b", ("bwd", 1, 3, 0),
+                      "grad").result(timeout=60)
+        assert b.call("wait_for", ("bwd", 1, 3, 0),
+                      30.0).result(timeout=60) == "grad"
+
+        # unknown destination: dropped driver-side, receiver times out
+        # with the named-waiter error instead of hanging
+        assert a.call("send_to", "peer-nobody", ("fwd", 9, 9, 9),
+                      "lost").result(timeout=60)
+        with pytest.raises(Exception, match="receiver actor"):
+            a.call("wait_for", ("never", 0, 0, 0), 0.2).result(
+                timeout=60)
+    finally:
+        backend.shutdown()

@@ -356,14 +356,13 @@ def test_undonated_zero1_budget_in_v4_skip_region(audited):
         f"{budget / GB:.2f} GB on v4-64")
 
 
-@pytest.mark.slow
-@pytest.mark.parametrize("audited", ["zero1"], indirect=True)
-def test_undonated_zero1_compile_audit(audited):
-    """Full leg (slow: a second ~2 min 1.3B compile): compile the SAME
-    zero1 program WITHOUT donation — the executable the skip region
-    actually dispatches — and audit it directly: identical argument
-    bytes, zero aliasing (the state copy is real), and the 2x-state
-    residents fit v4's budget at data=64."""
+@functools.lru_cache(maxsize=None)
+def _audited_undonated():
+    """Compile the SAME zero1 program WITHOUT donation — the
+    executable the v4 skip region actually dispatches — and return its
+    own ``memory_analysis``.  Memoized like ``_audited`` so the two
+    tests below share one ~2 min compile."""
+    audited = _audited("zero1")
     module = audited["module"]
     strat = Zero1Strategy()
     mesh = strat.build_mesh(batch_hint=GLOBAL_BATCH)
@@ -374,16 +373,34 @@ def test_undonated_zero1_compile_audit(audited):
                                    strat.batch_shardings(
                                        mesh, audited["batch"])),
                      out_shardings=(shardings, None))
-    mem = jitted.lower(audited["abstract"],
-                       audited["batch"]).compile().memory_analysis()
+    return jitted.lower(audited["abstract"],
+                        audited["batch"]).compile().memory_analysis()
+
+
+@pytest.mark.parametrize("audited", ["zero1"], indirect=True)
+def test_undonated_zero1_compile_audit(audited):
+    """The ROADMAP item-5 verdict gap, closed in tier-1: the un-donated
+    1.3B ZeRO-1 program's OWN ``memory_analysis`` (not numbers inferred
+    from the donated fixture) pins the skip-region story — identical
+    argument bytes, ZERO aliasing (the second state copy is real), a
+    state-sized output — and the 2x-state residents fit v4's budget at
+    data=64.  (Previously slow-gated behind a duplicate compile; the
+    memoized ``_audited_undonated`` makes the direct audit affordable
+    in the tier-1 window.)"""
+    mem = _audited_undonated()
     assert mem.argument_size_in_bytes == audited["compiled_args"]
     assert mem.alias_size_in_bytes == 0, \
         "un-donated program must not alias state buffers"
     # the un-donated output state copy really is state-sized
     assert mem.output_size_in_bytes >= 0.9 * audited["compiled_args"]
+    strat = Zero1Strategy()
     state64 = _state_bytes_at_dp(strat, audited["abstract"], 64)
     g_by, u_by = _shard_factors("zero1", 64)
-    total = 2 * state64 + _transient_bytes(
+    # scale the audited per-device outputs to dp=64 via the measured
+    # out/args ratio so the budget uses THIS program's numbers
+    out_over_args = (mem.output_size_in_bytes
+                     / mem.argument_size_in_bytes)
+    total = state64 * (1 + out_over_args) + _transient_bytes(
         audited["n_params"], 1, grads_sharded_by=g_by,
         updates_sharded_by=u_by)
     assert total <= HEADROOM * V4_HBM
