@@ -74,6 +74,15 @@ def main() -> None:
                       timed=TIMED_STEPS, baseline=BASELINES.get(metric),
                       trace_steps=trace_steps, inline_device_ms=True)
 
+    if os.environ.get("RLT_REMAT_AB") == "1":
+        # remat-policy ladder (benchmarks/bench_remat.py): compile +
+        # time every feasible policy of the headline fixture's
+        # configure_remat() ladder and emit ONE `remat` JSON field —
+        # per-policy device ms/step + HBM peak + measured winner vs the
+        # hand-picked default (gap documented when the hand pick wins).
+        from benchmarks.bench_remat import run_remat_ab
+        run_remat_ab(metric + "_remat")
+
     if os.environ.get("RLT_COMM_AB") == "1":
         # comm-plane A/B legs (benchmarks/bench_comm.py): fp32 floor,
         # flat int8, hierarchical int8/fp8/int4, and the bucketed-vs-

@@ -224,6 +224,19 @@ class LightningModule:
         dropout) the way GPTLightningModule does."""
         return self.configure_model()
 
+    def configure_remat(self):
+        """Planner-plane remat hook (core/remat.py): a ``RematSpec``
+        describing this module's rematerialization ladder — which
+        ``jax.checkpoint`` policies it supports, its current default,
+        how to reconfigure it in place, and a per-policy cost probe
+        (saved-activation bytes + recompute FLOPs from avals alone) —
+        so ``Trainer(strategy="auto")`` can sweep recompute-vs-HBM
+        tradeoffs as a scored axis instead of a hand A/B.  Default:
+        ``None`` (no remat lever; the planner records the axis as
+        ``remat_unsupported`` when a sweep was requested).  See
+        models/gpt.py for the reference implementation."""
+        return None
+
     def configure_mpmd(self):
         """MPMD-plane hook (ray_lightning_tpu/mpmd/): an ``MpmdSpec``
         describing this model as embed → N identical layers → head so

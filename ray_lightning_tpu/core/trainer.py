@@ -579,6 +579,18 @@ class Trainer:
             self.comm_policy = CommPolicy()
         self._plan_donate = bool(winner.donate)
         self.accumulate_grad_batches = int(winner.microbatch)
+        remat_pick = getattr(winner, "remat", "")
+        if remat_pick:
+            # apply the winning remat policy to the REAL module (the
+            # planner verified candidates on copy.copy clones, so the
+            # user's module still carries its default); resets the
+            # materialized model so _build_compiled traces the pick
+            spec = module.configure_remat()
+            if spec is not None and remat_pick != spec.default:
+                spec.apply(remat_pick)
+                module.setup_model()   # apply() dropped the stale wrap
+                _log.info("plan: remat policy %r applied (module "
+                          "default was %r)", remat_pick, spec.default)
         _log.info("plan: %s", report.summary())
         reg = _metrics.get_registry()
         if reg is not None:

@@ -44,6 +44,11 @@ class BertConfig:
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
     attention_impl: str = "auto"
+    # remat lever, same ladder as GPT (models/gpt.py GPTConfig): off by
+    # default — fine-tune batches fit easily — but present so the
+    # planner's remat axis (plan/) covers the BERT family too
+    remat: bool = False
+    remat_policy: str = "full"
 
     @property
     def head_dim(self) -> int:
@@ -95,8 +100,15 @@ class BertEncoder(nn.Module):
         pos = self.param("wpe", nn.initializers.normal(0.02),
                          (cfg.max_len, cfg.n_embd))
         x = tok + pos[:T].astype(cfg.dtype)
+        layer = EncoderLayer
+        if cfg.remat:
+            # HBM-for-FLOPs trade per encoder layer, same policy ladder
+            # as GPT's Block wrap (models/gpt.py)
+            from ray_lightning_tpu.models.gpt import _remat_policy
+            layer = nn.remat(EncoderLayer, static_argnums=(2,),
+                             policy=_remat_policy(cfg.remat_policy))
         for i in range(cfg.n_layer):
-            x = EncoderLayer(cfg, name=f"h{i}")(x, deterministic)
+            x = layer(cfg, name=f"h{i}")(x, deterministic)
         return nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
 
 
@@ -130,6 +142,61 @@ class BertForMaskedLM(nn.Module):
                         name="mlm_head")(h).astype(jnp.float32)
 
 
+def _bert_remat_spec(module):
+    """Shared ``configure_remat()`` body for both BERT modules (they
+    differ only in the head; the remat lever wraps the encoder layers
+    both share).  Same spec shape as GPT's (models/gpt.py), no MoE
+    extras."""
+    from ray_lightning_tpu.core import remat as _rm
+
+    policies = tuple(_rm.POLICY_LADDER)
+
+    def apply(policy: str) -> None:
+        if policy not in policies:
+            raise ValueError(f"remat policy {policy!r}; this config's "
+                             f"ladder: {list(policies)}")
+        cfg = module.config
+        module.config = dataclasses.replace(
+            cfg, remat=(policy != "off"),
+            remat_policy=(policy if policy != "off"
+                          else cfg.remat_policy))
+        module.model = None
+
+    def probe(policy: str, batch) -> _rm.RematProbe:
+        cfg = module.config
+        x = batch[0] if isinstance(batch, (tuple, list)) else batch
+        B, T = int(x.shape[0]), int(x.shape[1])
+        h = jax.ShapeDtypeStruct((B, T, cfg.n_embd), cfg.dtype)
+        params = jax.eval_shape(
+            lambda k: EncoderLayer(cfg).init(
+                k, jnp.zeros((1, T, cfg.n_embd), cfg.dtype),
+                True)["params"],
+            jax.random.PRNGKey(0))
+
+        def base_fn(p, hh):
+            return EncoderLayer(cfg).apply({"params": p}, hh, True)
+
+        if policy == "off":
+            fn = base_fn
+        else:
+            lyr = nn.remat(EncoderLayer, static_argnums=(2,),
+                           policy=_rm.policy_object(policy))(cfg)
+
+            def fn(p, hh):
+                return lyr.apply({"params": p}, hh, True)
+
+        s, f = _rm.block_cost(fn, base_fn, params, h)
+        return _rm.RematProbe(saved_bytes=cfg.n_layer * s,
+                              recompute_flops=cfg.n_layer * f,
+                              n_blocks=cfg.n_layer, batch=B)
+
+    return _rm.RematSpec(
+        policies=policies,
+        default=(module.config.remat_policy if module.config.remat
+                 else "off"),
+        apply=apply, probe=probe)
+
+
 class BertMLMModule(LightningModule):
     """Masked-LM pretraining (BERT's pretext task, TPU-first).
 
@@ -158,6 +225,9 @@ class BertMLMModule(LightningModule):
 
     def configure_model(self):
         return BertForMaskedLM(self.config)
+
+    def configure_remat(self):
+        return _bert_remat_spec(self)
 
     def configure_optimizers(self):
         return optax.adamw(self.lr, weight_decay=self.weight_decay)
@@ -247,6 +317,9 @@ class BertLightningModule(ClassificationModule):
 
     def configure_model(self):
         return BertClassifier(self.config)
+
+    def configure_remat(self):
+        return _bert_remat_spec(self)
 
     def configure_optimizers(self):
         sched = optax.linear_schedule(0.0, self.lr, self.warmup_steps)

@@ -175,32 +175,14 @@ class Block(nn.Module):
 
 def _remat_policy(name: str):
     """jax.checkpoint policy for a config/env name (None = save nothing,
-    jax's default — the max-recompute end of the walk)."""
-    name = os.environ.get("RLT_REMAT_POLICY") or name
-    cp = jax.checkpoint_policies
-    policies = {
-        "full": None,
-        "dots": cp.dots_saveable,
-        "dots_no_batch": cp.dots_with_no_batch_dims_saveable,
-        # dots + the named MoE intermediates (ops/moe.py checkpoint_name):
-        # gelu output / dispatch / combine live between dots and off —
-        # saving them keeps the expert backward's dgrad fusions off the
-        # recompute chains that drag them bandwidth-bound, without
-        # round-tripping EVERY intermediate the way "off" does
-        "dots_moe_act": cp.save_from_both_policies(
-            cp.dots_saveable, cp.save_only_these_names("moe_hact")),
-        "dots_moe": cp.save_from_both_policies(
-            cp.dots_saveable,
-            cp.save_only_these_names("moe_hact", "moe_dispatch",
-                                     "moe_combine")),
-        # saves every intermediate == remat disabled in effect; the
-        # no-recompute endpoint of the policy walk
-        "off": cp.everything_saveable,
-    }
-    if name not in policies:
-        raise ValueError(
-            f"remat_policy {name!r}; options: {sorted(policies)}")
-    return policies[name]
+    jax's default — the max-recompute end of the walk).  The canonical
+    name → policy mapping lives in core/remat.py ``policy_object`` (the
+    planner's ``configure_remat`` machinery shares it); this wrapper
+    keeps the ``RLT_REMAT_POLICY`` per-model-build override, which the
+    planner pins its sweep to when set (plan/candidates.py
+    ``resolve_remat_options``)."""
+    from ray_lightning_tpu.core.remat import policy_object
+    return policy_object(os.environ.get("RLT_REMAT_POLICY") or name)
 
 
 class GPT(nn.Module):
@@ -346,6 +328,86 @@ class GPTLightningModule(LightningModule):
 
     def configure_model(self):
         return GPT(self.config)
+
+    def configure_remat(self):
+        """Planner-plane remat surface (core/remat.py): the GPT policy
+        ladder — plus the ``checkpoint_name``-based MoE save lists when
+        this config routes experts — with a per-block probe pricing any
+        policy from avals alone.  ``apply`` folds a policy back into the
+        config the way ``RLT_REMAT_POLICY`` used to per-build ("off"
+        drops the ``nn.remat`` wrap entirely, matching the tiny/small
+        configs' ``remat=False``)."""
+        from ray_lightning_tpu.core import remat as _rm
+
+        policies = list(_rm.POLICY_LADDER)
+        if self.config.n_experts > 0:
+            policies += list(_rm.MOE_POLICIES)
+
+        def apply(policy: str) -> None:
+            if policy not in policies:
+                raise ValueError(f"remat policy {policy!r}; this "
+                                 f"config's ladder: {policies}")
+            cfg = self.config
+            self.config = dataclasses.replace(
+                cfg, remat=(policy != "off"),
+                remat_policy=(policy if policy != "off"
+                              else cfg.remat_policy))
+            self.model = None   # next setup_model() rebuilds the wrap
+
+        _base_flops: dict = {}   # (use_moe, B, T) -> baseline bwd flops
+
+        def probe(policy: str, batch) -> _rm.RematProbe:
+            cfg = self.config
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            B, T = int(x.shape[0]), int(x.shape[1])
+            h = jax.ShapeDtypeStruct((B, T, cfg.n_embd), cfg.dtype)
+            n_moe = sum(
+                1 for i in range(cfg.n_layer)
+                if cfg.n_experts > 0
+                and i % cfg.moe_every == cfg.moe_every - 1)
+            saved = flops = 0
+            for count, use_moe in ((cfg.n_layer - n_moe, False),
+                                   (n_moe, True)):
+                if count == 0:
+                    continue
+
+                def base_fn(p, hh, _moe=use_moe):
+                    return Block(cfg, use_moe=_moe).apply(
+                        {"params": p}, hh, True)
+
+                params = jax.eval_shape(
+                    lambda k, _moe=use_moe: Block(cfg, use_moe=_moe).init(
+                        k, jnp.zeros((1, T, cfg.n_embd), cfg.dtype),
+                        True)["params"],
+                    jax.random.PRNGKey(0))
+                key = (use_moe, B, T)
+                if key not in _base_flops:
+                    _base_flops[key] = _rm.grad_dot_flops(base_fn,
+                                                          params, h)
+                if policy == "off":
+                    fn = base_fn
+                else:
+                    blk = nn.remat(
+                        Block, static_argnums=(2,),
+                        policy=_rm.policy_object(policy))(
+                            cfg, use_moe=use_moe)
+
+                    def fn(p, hh, _b=blk):
+                        return _b.apply({"params": p}, hh, True)
+
+                s, f = _rm.block_cost(fn, base_fn, params, h,
+                                      base_flops=_base_flops[key])
+                saved += count * s
+                flops += count * f
+            return _rm.RematProbe(saved_bytes=saved,
+                                  recompute_flops=flops,
+                                  n_blocks=self.config.n_layer, batch=B)
+
+        return _rm.RematSpec(
+            policies=tuple(policies),
+            default=(self.config.remat_policy if self.config.remat
+                     else "off"),
+            apply=apply, probe=probe)
 
     def configure_decode_model(self):
         """Serve-plane model (serve/engine.py): the SAME param tree as

@@ -23,6 +23,7 @@ import optax
 from ray_lightning_tpu.core.data import DataLoader
 from ray_lightning_tpu.core.module import LightningModule
 from ray_lightning_tpu.models.gpt import (CONFIGS, Block, GPTConfig,
+                                          _remat_policy,
                                           synthetic_lm_dataset)
 from ray_lightning_tpu.parallel.pipeline import pipeline_forward
 
@@ -108,6 +109,60 @@ class PipelinedGPT(LightningModule):
         return optax.adamw(self.lr, weight_decay=self.weight_decay,
                            b1=0.9, b2=0.95)
 
+    # -- remat ladder (core/remat.py; planner axis) ----------------------
+
+    def configure_remat(self):
+        """Same ladder as GPT minus the MoE save lists (this model
+        rejects MoE configs); one probe block kind — the scanned
+        ``Block`` every stage runs."""
+        from ray_lightning_tpu.core import remat as _rm
+
+        policies = tuple(_rm.POLICY_LADDER)
+
+        def apply(policy: str) -> None:
+            if policy not in policies:
+                raise ValueError(f"remat policy {policy!r}; this "
+                                 f"config's ladder: {list(policies)}")
+            cfg = self.config
+            self.config = dataclasses.replace(
+                cfg, remat=(policy != "off"),
+                remat_policy=(policy if policy != "off"
+                              else cfg.remat_policy))
+            self._block = Block(self.config)
+
+        def probe(policy: str, batch) -> _rm.RematProbe:
+            cfg = self.config
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            B, T = int(x.shape[0]), int(x.shape[1])
+            h = jax.ShapeDtypeStruct((B, T, cfg.n_embd), cfg.dtype)
+            params = jax.eval_shape(
+                lambda k: self._block.init(
+                    k, jnp.zeros((1, T, cfg.n_embd), cfg.dtype),
+                    True)["params"],
+                jax.random.PRNGKey(0))
+
+            def base_fn(p, hh):
+                return self._block.apply({"params": p}, hh, True)
+
+            if policy == "off":
+                fn = base_fn
+            else:
+                pol = _rm.policy_object(policy)
+
+                def fn(p, hh):
+                    return jax.checkpoint(base_fn, policy=pol)(p, hh)
+
+            s, f = _rm.block_cost(fn, base_fn, params, h)
+            return _rm.RematProbe(saved_bytes=cfg.n_layer * s,
+                                  recompute_flops=cfg.n_layer * f,
+                                  n_blocks=cfg.n_layer, batch=B)
+
+        return _rm.RematSpec(
+            policies=policies,
+            default=(self.config.remat_policy if self.config.remat
+                     else "off"),
+            apply=apply, probe=probe)
+
     # -- MPMD partition (ray_lightning_tpu/mpmd/) ------------------------
 
     def configure_mpmd(self):
@@ -135,7 +190,11 @@ class PipelinedGPT(LightningModule):
             return out
 
         if cfg.remat:
-            stage_fn = jax.checkpoint(stage_fn)
+            # same policy ladder as GPT (was boolean-only full remat):
+            # MPMD stage programs can now trade stash memory against
+            # recompute per policy — ROADMAP item 1c's prerequisite
+            stage_fn = jax.checkpoint(
+                stage_fn, policy=_remat_policy(cfg.remat_policy))
 
         def head_loss_fn(params, h, batch):
             _, y = batch
@@ -166,8 +225,10 @@ class PipelinedGPT(LightningModule):
 
         if cfg.remat:
             # same HBM-for-FLOPs trade GPT applies via nn.remat
-            # (gpt.py Block wrapping): recompute each layer on backward
-            stage_fn = jax.checkpoint(stage_fn)
+            # (gpt.py Block wrapping), at the SAME policy ladder —
+            # replacing the old boolean-only (always-full) checkpoint
+            stage_fn = jax.checkpoint(
+                stage_fn, policy=_remat_policy(cfg.remat_policy))
         h = pipeline_forward(stage_fn, params["blocks"], h,
                              n_microbatches=self.n_microbatches)
         h = _layernorm(h, params["ln_f"]["scale"], params["ln_f"]["bias"])

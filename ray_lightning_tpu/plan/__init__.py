@@ -1,8 +1,9 @@
 """Planner plane: cost-model-driven auto-parallelism.
 
 ``Trainer(strategy="auto")`` routes here: enumerate candidate plans
-(strategy × mesh × comm × donation × microbatch), score them from the
-byte/HBM models WITHOUT compiling, AOT-verify the top-k through the
+(strategy × mesh × comm × donation × microbatch × remat policy), score
+them from the byte/HBM models WITHOUT compiling, AOT-verify the top-k
+through the
 persistent compile cache, and pick deterministically — emitting a
 machine-readable :class:`PlanReport` on ``trainer._plan_report``, in
 bench JSON, and as ``rlt_plan_*`` metrics.  See plan/planner.py for
@@ -11,10 +12,12 @@ the full pipeline and the cross-rank determinism contract.
 
 from ray_lightning_tpu.plan.candidates import (Candidate,
                                                enumerate_candidates,
-                                               policy_for_candidate)
+                                               policy_for_candidate,
+                                               resolve_remat_options)
 from ray_lightning_tpu.plan.config import ENV_KNOBS, PlanConfig
 from ray_lightning_tpu.plan.cost import (Estimate, estimate_candidate,
-                                         rank_key, sharded_bytes)
+                                         rank_key, remat_terms,
+                                         sharded_bytes)
 from ray_lightning_tpu.plan.planner import Planner, clear_plan_memo
 from ray_lightning_tpu.plan.report import (ENTRY_KEYS, REPORT_KEYS,
                                            PlanReport, make_entry)
@@ -34,5 +37,7 @@ __all__ = [
     "make_entry",
     "policy_for_candidate",
     "rank_key",
+    "remat_terms",
+    "resolve_remat_options",
     "sharded_bytes",
 ]

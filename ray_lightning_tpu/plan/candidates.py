@@ -2,7 +2,9 @@
 
 A *candidate* is one complete parallelism configuration the trainer
 could run: strategy × mesh factorization × comm policy on/off ×
-donation on/off × grad-accumulation microbatch.  Enumeration here is
+donation on/off × grad-accumulation microbatch × remat policy (when
+the module declares a ``configure_remat()`` ladder —
+``resolve_remat_options``).  Enumeration here is
 purely combinatorial — strategies self-describe their feasible meshes
 via the ``plan_mesh_options`` / ``from_plan`` hooks
 (parallel/strategy.py) — and prunes statically-infeasible combinations
@@ -29,6 +31,8 @@ class Candidate:
     comm: bool = False            # compressed gradient collectives on?
     donate: bool = True           # donate the TrainState into the step?
     microbatch: int = 1           # accumulate_grad_batches
+    remat: str = ""               # remat policy ("" = module default /
+    #                               no configure_remat() ladder)
 
     @property
     def label(self) -> str:
@@ -40,6 +44,8 @@ class Candidate:
             parts.append("nodonate")
         if self.microbatch > 1:
             parts.append(f"mb{self.microbatch}")
+        if self.remat:
+            parts.append(f"rm-{self.remat}")
         return ":".join(parts)
 
     @property
@@ -64,6 +70,7 @@ class Candidate:
             "comm": self.comm,
             "donate": self.donate,
             "microbatch": self.microbatch,
+            "remat": self.remat or None,
         }
 
 
@@ -88,6 +95,47 @@ def policy_for_candidate(candidate: Candidate, base_policy=None):
     return CommPolicy(compress="int8", axes=("data",), hierarchy=HIER_AUTO)
 
 
+def resolve_remat_options(spec, config: PlanConfig
+                          ) -> "tuple[tuple, list[tuple[str, str]]]":
+    """The remat-policy axis for this module: ``(options, pruned)``.
+
+    ``spec`` is the module's ``configure_remat()`` result (or ``None``).
+    No spec → the axis collapses to ``("",)`` (module default), with a
+    named ``remat_unsupported`` prune entry when a sweep was explicitly
+    requested (``config.remat`` / ``RLT_REMAT_POLICY``).  With a spec,
+    ``config.remat`` (default: the module's whole ladder) is validated
+    against the ladder — unknown names prune by name — and a set
+    ``RLT_REMAT_POLICY`` pins the axis to that single policy, because
+    the model-build override would force every candidate's compiled
+    program to it anyway (models/gpt.py ``_remat_policy``).
+    """
+    import os
+    pruned: list[tuple[str, str]] = []
+    env = os.environ.get("RLT_REMAT_POLICY", "").strip()
+    if spec is None:
+        if config.remat or env:
+            pruned.append((
+                "remat",
+                "remat_unsupported: module declares no configure_remat() "
+                "ladder (core/module.py hook); the remat axis is skipped"))
+        return ("",), pruned
+    requested = (env,) if env else (tuple(config.remat)
+                                    or tuple(spec.policies))
+    options: list = []
+    for p in requested:
+        if p not in spec.policies:
+            pruned.append((
+                f"rm-{p}",
+                f"remat_unsupported: policy {p!r} is not in this "
+                f"module's ladder {tuple(spec.policies)}"))
+            continue
+        if p not in options:
+            options.append(p)
+    if not options:
+        options = [spec.default]
+    return tuple(options), pruned
+
+
 def enumerate_candidates(
     n_devices: int,
     global_batch: Optional[int],
@@ -95,6 +143,7 @@ def enumerate_candidates(
     process_count: int = 1,
     microbatch_options: Optional[tuple] = None,
     comm_enabled_hint: bool = False,
+    remat_options: tuple = ("",),
 ) -> "tuple[list[Candidate], list[tuple[str, str]]]":
     """All statically-feasible candidates plus the pruned combinations.
 
@@ -156,7 +205,8 @@ def enumerate_candidates(
                             f"{mb} microbatches over {dp} data shards"))
                         continue
                     for donate in (True, False):
-                        candidates.append(dataclasses.replace(
-                            base, comm=comm, donate=donate,
-                            microbatch=mb))
+                        for rp in remat_options:
+                            candidates.append(dataclasses.replace(
+                                base, comm=comm, donate=donate,
+                                microbatch=mb, remat=rp))
     return candidates, pruned

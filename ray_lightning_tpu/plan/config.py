@@ -9,6 +9,7 @@ pattern (first match wins):
 - ``AutoStrategy(plan=...)`` — per-strategy override;
 - ``RLT_PLAN_TOPK`` / ``RLT_PLAN_ICI_GBPS`` / ``RLT_PLAN_DCN_GBPS`` /
   ``RLT_PLAN_STRATEGIES`` / ``RLT_PLAN_MICROBATCH`` /
+  ``RLT_PLAN_REMAT`` / ``RLT_PLAN_HBM_GBPS`` / ``RLT_PLAN_TFLOPS`` /
   ``RLT_PLAN_HBM_BYTES`` / ``RLT_PLAN_HEADROOM`` — env knobs, read when
   the Trainer arg is ``None``.
 - ``RLT_PLAN_CALIBRATE=1`` — replace the bandwidth constants with
@@ -44,8 +45,23 @@ ENV_MICROBATCH = "RLT_PLAN_MICROBATCH"
 ENV_HBM = "RLT_PLAN_HBM_BYTES"
 ENV_HEADROOM = "RLT_PLAN_HEADROOM"
 ENV_CALIBRATE = "RLT_PLAN_CALIBRATE"
+ENV_REMAT = "RLT_PLAN_REMAT"
+ENV_HBM_GBPS = "RLT_PLAN_HBM_GBPS"
+ENV_TFLOPS = "RLT_PLAN_TFLOPS"
 ENV_KNOBS = (ENV_TOPK, ENV_ICI, ENV_DCN, ENV_STRATEGIES, ENV_MICROBATCH,
-             ENV_HBM, ENV_HEADROOM, ENV_CALIBRATE)
+             ENV_HBM, ENV_HEADROOM, ENV_CALIBRATE, ENV_REMAT,
+             ENV_HBM_GBPS, ENV_TFLOPS)
+
+#: modeled HBM bandwidth the remat cost term charges saved-activation
+#: round-trips at (v5e-class default, same convention as the comm-plane
+#: link constants); override per device generation
+HBM_GBPS = 819.0
+#: modeled ACHIEVED matmul rate for recompute chains — deliberately
+#: below a v5e's ~197 bf16 peak TFLOPs because remat'd forward
+#:re-execution runs inside backward fusions at well under peak MFU
+#: (calibrated against the measured gpt2-medium full-vs-dots walk,
+#: benchmarks/README.md round 4)
+DEVICE_TFLOPS = 65.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -65,6 +81,18 @@ class PlanConfig:
     microbatch: candidate ``accumulate_grad_batches`` values.  ``(1,)``
         by default — microbatching only trades step time for memory, so
         it is an opt-in dimension.
+    remat: candidate remat-policy names.  ``()`` (the default) sweeps
+        every policy the module's ``configure_remat()`` ladder
+        declares; a non-empty tuple restricts the sweep (unsupported
+        names are pruned as ``remat_unsupported``).  An
+        ``RLT_REMAT_POLICY`` env override pins the axis to that single
+        policy (plan/candidates.py ``resolve_remat_options``) — the
+        sweep would compile programs the env forces to one policy
+        anyway.
+    hbm_gbps: modeled HBM bandwidth for the remat activation-traffic
+        term (saved activations cost one store + one load per step).
+    device_tflops: modeled achieved matmul rate for the remat
+        recompute-FLOPs term (below peak — see DEVICE_TFLOPS note).
     hbm_budget_bytes: per-device memory budget override (None = ask the
         device, like the donation heuristic does).
     headroom: fraction of the budget modeled residents may use (the
@@ -86,10 +114,16 @@ class PlanConfig:
     dcn_gbps: float = DCN_GBPS
     strategies: tuple = PLANNABLE_STRATEGIES
     microbatch: tuple = (1,)
+    remat: tuple = ()
+    hbm_gbps: float = HBM_GBPS
+    device_tflops: float = DEVICE_TFLOPS
     hbm_budget_bytes: Optional[int] = None
     headroom: float = 0.9
     activation_factor: float = 8.0
-    max_candidates: int = 64
+    # the remat axis multiplies the space (a 6-policy MoE ladder over
+    # the PR-8 axes lands near 100); the cap exists against runaway
+    # enumeration, not to truncate the default sweep
+    max_candidates: int = 256
     reuse: bool = True
 
     def __post_init__(self):
@@ -112,6 +146,13 @@ class PlanConfig:
         if not mb or any(m < 1 for m in mb):
             raise ValueError("plan microbatch values must be >= 1")
         object.__setattr__(self, "microbatch", mb)
+        rm = tuple(str(p) for p in self.remat)
+        if any(not p for p in rm):
+            raise ValueError("plan remat policy names must be non-empty")
+        object.__setattr__(self, "remat", rm)
+        if self.hbm_gbps <= 0 or self.device_tflops <= 0:
+            raise ValueError(
+                "plan hbm_gbps / device_tflops must be positive")
 
     # -- construction ----------------------------------------------------
 
@@ -145,6 +186,15 @@ class PlanConfig:
         raw = os.environ.get(ENV_MICROBATCH, "").strip()
         if raw:
             kw["microbatch"] = tuple(int(m) for m in raw.split(",") if m)
+        raw = os.environ.get(ENV_REMAT, "").strip()
+        if raw:
+            kw["remat"] = tuple(p for p in raw.split(",") if p)
+        raw = os.environ.get(ENV_HBM_GBPS, "").strip()
+        if raw:
+            kw["hbm_gbps"] = float(raw)
+        raw = os.environ.get(ENV_TFLOPS, "").strip()
+        if raw:
+            kw["device_tflops"] = float(raw)
         raw = os.environ.get(ENV_HBM, "").strip()
         if raw:
             kw["hbm_budget_bytes"] = int(raw)
@@ -171,6 +221,12 @@ class PlanConfig:
             env[ENV_STRATEGIES] = ",".join(self.strategies)
         if self.microbatch != default.microbatch:
             env[ENV_MICROBATCH] = ",".join(str(m) for m in self.microbatch)
+        if self.remat != default.remat:
+            env[ENV_REMAT] = ",".join(self.remat)
+        if self.hbm_gbps != default.hbm_gbps:
+            env[ENV_HBM_GBPS] = repr(self.hbm_gbps)
+        if self.device_tflops != default.device_tflops:
+            env[ENV_TFLOPS] = repr(self.device_tflops)
         if self.hbm_budget_bytes is not None:
             env[ENV_HBM] = str(self.hbm_budget_bytes)
         if self.headroom != default.headroom:

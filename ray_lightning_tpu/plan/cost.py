@@ -19,10 +19,24 @@ Two estimates per candidate, both computed WITHOUT compiling anything:
   tests/test_memory_fit.py account), plus the big transients (grads at
   param dtype and fp32 update deltas, mirroring the PARAM sharding —
   replicated-param strategies materialize them full-size, param-sharded
-  ones keep them shard-sized) and a crude batch-proportional activation
-  proxy that grad-accumulation divides.  Donation follows the measured
-  decision logic: an un-donated step carries a second state copy
-  (old + new — the ``Trainer._donation_cutoff`` story).
+  ones keep them shard-sized) and an activation term: when the module
+  declares a ``configure_remat()`` ladder, the candidate policy's
+  SAVED-ACTIVATION bytes (core/remat.py probe — eval_shape of each
+  block's saveable residual set, scaled to the candidate's per-device
+  microbatch and damped by :data:`REMAT_RESIDENCY_FACTOR` for XLA's
+  buffer sharing); otherwise the crude batch-proportional proxy of
+  PR 8.  Donation follows the measured decision logic: an un-donated
+  step carries a second state copy (old + new — the
+  ``Trainer._donation_cutoff`` story).
+- **remat seconds** (:func:`remat_terms`): what the candidate's remat
+  policy costs per step — saved activations pay one HBM store + one
+  load (``2·bytes / hbm_gbps``), recomputed matmuls pay
+  ``flops / device_tflops`` at the deliberately-sub-peak achieved
+  rate, and every remat region pays a small fixed scheduling overhead
+  per microbatch (:data:`REMAT_BLOCK_OVERHEAD_S`) — the term that
+  makes "off" win on small models where recompute latency, not bytes,
+  dominates.  This is the score that trades memory against recompute
+  against comm: it adds to the comm seconds in :func:`rank_key`.
 
 Candidates whose modeled peak exceeds the headroom-scaled budget are
 rejected with a named reason; the AOT verify stage later replaces these
@@ -69,6 +83,46 @@ def _sharded_elements(abstract_tree, shardings_tree) -> int:
     return total
 
 
+#: fraction of a policy's RAW saved-residual bytes modeled as live HBM
+#: (and round-tripped): ``saved_residuals`` lists every residual at its
+#: own dtype while XLA's buffer assignment shares/dedups aggressively —
+#: calibrated against compiled ``memory_analysis`` temp deltas of the
+#: tiny-GPT programs (tests/test_plan.py remat drift leg) and the
+#: measured gpt2-medium walk (off 18.95 GB vs dots ~10 GB,
+#: benchmarks/README.md round 4)
+REMAT_RESIDENCY_FACTOR = 0.3
+
+#: modeled fixed cost of one remat region's backward re-entry (extra
+#: kernel launches + the fusion break at the region boundary) per
+#: microbatch — the term that keeps "off" the winner on tiny models
+#: where the saved bytes are microseconds of traffic
+REMAT_BLOCK_OVERHEAD_S = 5e-6
+
+
+def remat_terms(probe, policy: str, config: PlanConfig,
+                process_count: int, dp: int,
+                microbatch: int) -> "tuple[int, float]":
+    """(peak activation bytes, remat seconds) for one candidate.
+
+    ``probe`` is the module's :class:`~ray_lightning_tpu.core.remat.
+    RematProbe` at the process-LOCAL example batch; every probe
+    quantity is linear in batch, so the per-device step scale is
+    ``process_count / dp`` (global batch = local × processes, split
+    over dp data shards).  Peak residency divides by the microbatch
+    count (only one microbatch's activations are live); traffic and
+    recompute do not (every microbatch pays them each step).
+    """
+    scale = process_count / max(1, dp)
+    saved = probe.saved_bytes * REMAT_RESIDENCY_FACTOR * scale
+    act_bytes = int(saved / max(1, microbatch))
+    seconds = bytes_to_seconds(2 * saved, config.hbm_gbps)
+    seconds += (probe.recompute_flops * scale
+                / (config.device_tflops * 1e12))
+    if policy != "off":
+        seconds += probe.n_blocks * microbatch * REMAT_BLOCK_OVERHEAD_S
+    return act_bytes, seconds
+
+
 def link_gbps(op: str, config: PlanConfig, process_count: int) -> float:
     """The modeled bandwidth ONE declared collective op rides (module
     docstring): ``_ici``-suffixed ops always score at ICI speed; every
@@ -109,10 +163,19 @@ class Estimate:
     donate_preferred: bool     # what the measured donation heuristic
     #                            would pick for this state/budget pair
     reason: Optional[str] = None   # rejection reason (None = fits)
+    remat_policy: str = ""     # candidate's policy ("" = no remat axis)
+    act_bytes: int = 0         # modeled live activations (remat-aware
+    #                            when the module declares a ladder)
+    remat_seconds: float = 0.0  # traffic + recompute + region overhead
 
     @property
     def fits(self) -> bool:
         return self.reason is None
+
+    @property
+    def step_seconds(self) -> float:
+        """The modeled per-step cost that ranks: comm + remat."""
+        return self.comm_seconds + self.remat_seconds
 
     def to_dict(self) -> dict:
         return {
@@ -122,6 +185,9 @@ class Estimate:
             "peak_bytes": int(self.peak_bytes),
             "budget_bytes": self.budget,
             "donate_preferred": self.donate_preferred,
+            "remat_policy": self.remat_policy or None,
+            "act_bytes": int(self.act_bytes),
+            "remat_seconds": float(self.remat_seconds),
         }
 
 
@@ -135,8 +201,13 @@ def estimate_candidate(
     config: PlanConfig,
     process_count: int,
     grad_sync=None,
+    remat_probe=None,
 ) -> Estimate:
-    """Score one candidate from avals alone (module docstring)."""
+    """Score one candidate from avals alone (module docstring).
+
+    ``remat_probe`` is the module's priced :class:`RematProbe` for THIS
+    candidate's policy (None when the module has no remat ladder — the
+    activation term then falls back to the PR-8 batch proxy)."""
     from ray_lightning_tpu.core.trainer import Trainer
 
     op_bytes = strategy.step_collective_bytes(mesh, abstract_state,
@@ -154,8 +225,14 @@ def estimate_candidate(
     updates_bytes = 4 * _sharded_elements(abstract_state.params,
                                           shardings.params)
     dp = max(1, strategy.data_parallel_size(mesh))
-    act_bytes = int(batch_bytes_global / dp * config.activation_factor
-                    / max(1, candidate.microbatch))
+    remat_seconds = 0.0
+    if remat_probe is not None:
+        act_bytes, remat_seconds = remat_terms(
+            remat_probe, candidate.remat, config, process_count, dp,
+            max(1, candidate.microbatch))
+    else:
+        act_bytes = int(batch_bytes_global / dp * config.activation_factor
+                        / max(1, candidate.microbatch))
     peak = (state_bytes * (1 if candidate.donate else 2)
             + grads_bytes + updates_bytes + act_bytes)
 
@@ -171,17 +248,20 @@ def estimate_candidate(
     return Estimate(comm_bytes=comm_bytes, comm_seconds=comm_seconds,
                     state_bytes=state_bytes, peak_bytes=peak,
                     budget=budget, donate_preferred=donate_preferred,
-                    reason=reason)
+                    reason=reason, remat_policy=candidate.remat,
+                    act_bytes=act_bytes, remat_seconds=remat_seconds)
 
 
 def rank_key(candidate: Candidate, est: Estimate) -> tuple:
     """Deterministic ranking key for modeled scores: fewest modeled
-    comm seconds first; between otherwise-equal candidates the donation
-    flag agreeing with the MEASURED donation heuristic wins (small
-    states run faster un-donated, large/unknown donate —
-    ``Trainer._donation_cutoff``); then lower peak, then label (total
-    order — every rank of an SPMD fleet computes the same key from the
-    same pickled config, which is what lets ``strategy="auto"`` agree
-    on one winner without a collective)."""
+    per-step seconds first (comm + remat — the remat term is what lets
+    recompute-vs-HBM trade against wire bytes in one total order);
+    between otherwise-equal candidates the donation flag agreeing with
+    the MEASURED donation heuristic wins (small states run faster
+    un-donated, large/unknown donate — ``Trainer._donation_cutoff``);
+    then lower peak, then label (total order — every rank of an SPMD
+    fleet computes the same key from the same pickled config, which is
+    what lets ``strategy="auto"`` agree on one winner without a
+    collective)."""
     mismatch = 0 if candidate.donate == est.donate_preferred else 1
-    return (est.comm_seconds, mismatch, est.peak_bytes, candidate.label)
+    return (est.step_seconds, mismatch, est.peak_bytes, candidate.label)

@@ -4,12 +4,15 @@
 winning :class:`Candidate` plus a full :class:`PlanReport`:
 
 1. **Enumerate** (plan/candidates.py): strategy × mesh factorization ×
-   comm × donation × microbatch, statically-infeasible combinations
+   comm × donation × microbatch × remat policy (the module's
+   ``configure_remat()`` ladder), statically-infeasible combinations
    pruned with named reasons.
 2. **Score without compiling** (plan/cost.py): per-step communication
    seconds from each strategy's ``step_collective_bytes`` declaration
    through the per-link bandwidth model, HBM peak from ``eval_shape``
-   avals + shardings + the measured donation decision logic;
+   avals + shardings + the measured donation decision logic (with the
+   candidate policy's saved-activation bytes as the activation term),
+   plus the remat policy's modeled traffic/recompute seconds;
    over-budget candidates rejected with named reasons.
 3. **Verify cheaply** (compile/aot.py ``compile_scored``): AOT-compile
    only the top-k modeled survivors — in parallel, through the
@@ -33,6 +36,7 @@ compile cache).
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import logging
 import threading
@@ -44,7 +48,8 @@ import numpy as np
 
 from ray_lightning_tpu.plan.candidates import (Candidate,
                                                enumerate_candidates,
-                                               policy_for_candidate)
+                                               policy_for_candidate,
+                                               resolve_remat_options)
 from ray_lightning_tpu.plan.config import PlanConfig
 from ray_lightning_tpu.plan.cost import (estimate_candidate, rank_key,
                                          sharded_bytes)
@@ -93,6 +98,10 @@ class _Built:
     abstract: object
     shardings: object
     estimate: object
+    #: the module this candidate's programs build from — a per-policy
+    #: reconfigured copy when the candidate carries a remat policy, the
+    #: caller's module otherwise
+    module: object = None
 
 
 class Planner:
@@ -136,12 +145,28 @@ class Planner:
                     shardings.opt_state, abstract.opt_state))
         return strategy, mesh, grad_sync, tx, abstract, shardings
 
-    def _jitted_step(self, module, built: _Built, gb_abstract):
+    @staticmethod
+    def _module_for_policy(module, spec, policy: str, cache: dict):
+        """The module a candidate's programs trace through: for a
+        non-default remat policy, a ``copy.copy`` clone reconfigured
+        via its own ``configure_remat().apply`` (the clone's spec binds
+        the clone, so the caller's module stays on its default until
+        the trainer applies the winner)."""
+        if spec is None or not policy or policy == spec.default:
+            return module
+        if policy not in cache:
+            clone = copy.copy(module)
+            clone.configure_remat().apply(policy)
+            cache[policy] = clone
+        return cache[policy]
+
+    def _jitted_step(self, built: _Built, gb_abstract):
         """The candidate's real train-step jit, wired exactly as the
-        trainer's ``_build_compiled`` would wire it."""
+        trainer's ``_build_compiled`` would wire it (through the
+        candidate's own remat-configured module)."""
         from ray_lightning_tpu.core.steps import build_train_step
         cand = built.candidate
-        step = build_train_step(module, built.tx, cand.microbatch,
+        step = build_train_step(built.module, built.tx, cand.microbatch,
                                 grad_sync=built.grad_sync)
         kw = dict(out_shardings=(built.shardings, None))
         if cand.donate:
@@ -194,13 +219,33 @@ class Planner:
                 self._note_tune(report)
                 return report
 
+        # remat axis: the module's configure_remat() ladder (None = no
+        # lever) priced per policy from avals BEFORE enumeration, so a
+        # policy whose probe fails drops out with a named prune instead
+        # of sinking every candidate that carries it
+        spec = module.configure_remat()
+        remat_options, remat_pruned = resolve_remat_options(spec, cfg)
+        probes: dict = {}
+        if spec is not None:
+            options = []
+            for p in remat_options:
+                try:
+                    probes[p] = spec.probe(p, example_batch)
+                    options.append(p)
+                except Exception as e:   # noqa: BLE001 - per-policy soft
+                    remat_pruned.append((
+                        f"rm-{p}",
+                        f"remat_probe_error: {type(e).__name__}: {e}"))
+            remat_options = tuple(options) or ("",)
+
         comm_hint = base_comm_policy is not None and base_comm_policy.enabled
         candidates, pruned = enumerate_candidates(
             len(devices), batch_hint, cfg, process_count=pc,
             microbatch_options=microbatch_options,
-            comm_enabled_hint=comm_hint)
+            comm_enabled_hint=comm_hint,
+            remat_options=remat_options)
         entries = [make_entry(label, "pruned", reason)
-                   for label, reason in pruned]
+                   for label, reason in list(remat_pruned) + list(pruned)]
         if len(candidates) > cfg.max_candidates:
             for cand in candidates[cfg.max_candidates:]:
                 entries.append(make_entry(
@@ -215,11 +260,15 @@ class Planner:
 
         # -- score (no compiles) ------------------------------------------
         abstract_cache: dict = {}
+        policy_modules: dict = {}
         built: list[_Built] = []
         for cand in candidates:
+            cand_module = self._module_for_policy(module, spec,
+                                                  cand.remat,
+                                                  policy_modules)
             try:
                 strategy, mesh, gs, tx, abstract, shardings = self._build(
-                    module, cand, devices, batch_hint, example_batch,
+                    cand_module, cand, devices, batch_hint, example_batch,
                     tx_factory, base_comm_policy, abstract_cache)
             except _Infeasible as e:
                 entries.append(make_entry(cand, "rejected", str(e)))
@@ -231,13 +280,14 @@ class Planner:
                 continue
             est = estimate_candidate(cand, strategy, mesh, abstract,
                                      shardings, batch_bytes, cfg, pc,
-                                     grad_sync=gs)
+                                     grad_sync=gs,
+                                     remat_probe=probes.get(cand.remat))
             if not est.fits:
                 entries.append(make_entry(cand, "rejected", est.reason,
                                           modeled=est.to_dict()))
                 continue
             built.append(_Built(cand, strategy, mesh, gs, tx, abstract,
-                                shardings, est))
+                                shardings, est, module=cand_module))
 
         built.sort(key=lambda b: rank_key(b.candidate, b.estimate))
 
@@ -252,7 +302,7 @@ class Planner:
         programs = []
         for b in top:
             try:
-                jitted = self._jitted_step(module, b, gb_abstract)
+                jitted = self._jitted_step(b, gb_abstract)
             except Exception as e:   # noqa: BLE001 - per-candidate soft
                 entries.append(make_entry(
                     b.candidate, "rejected",
@@ -306,8 +356,11 @@ class Planner:
                 audited_seconds = bytes_to_seconds(sc.wire_bytes, gbps)
             mismatch = 0 if b.candidate.donate \
                 == b.estimate.donate_preferred else 1
-            key = (audited_seconds, mismatch, sc.peak_bytes,
-                   b.candidate.label)
+            # the remat term stays modeled through the verify re-rank
+            # (compiling changes what we know about MEMORY, not about
+            # recompute seconds) — still a pure function of config+avals
+            key = (audited_seconds + b.estimate.remat_seconds, mismatch,
+                   sc.peak_bytes, b.candidate.label)
             measured = sc.to_dict()
             measured["audited_seconds"] = audited_seconds
             verified.append((key, b, measured))

@@ -16,14 +16,16 @@ import dataclasses
 from typing import Optional
 
 #: top-level keys every ``PlanReport.to_dict()`` carries (schema pinned
-#: by plan/selfcheck.py; bench_plan.py and the tests consume these)
+#: by plan/selfcheck.py; bench_plan.py and the tests consume these).
+#: ``remat`` is the per-policy ladder summary at the winner's other
+#: axes (None when the module has no configure_remat() ladder).
 REPORT_KEYS = ("winner", "topk", "plan_seconds", "cache_misses",
                "reused", "enumerated", "pruned", "rejected", "scored",
-               "compiled", "candidates")
+               "compiled", "candidates", "remat")
 
 #: keys every per-candidate entry carries
 ENTRY_KEYS = ("label", "strategy", "mesh", "comm", "donate",
-              "microbatch", "status", "reason")
+              "microbatch", "remat", "status", "reason")
 
 STATUSES = ("pruned", "rejected", "scored", "compiled", "winner")
 
@@ -47,6 +49,35 @@ class PlanReport:
     def _count(self, status: str) -> int:
         return sum(1 for e in self.entries if e["status"] == status)
 
+    def _remat_summary(self) -> "Optional[dict]":
+        """Per-policy ladder at the winner's OTHER axes: the one-look
+        answer to "what did each remat policy model to" — modeled HBM
+        peak / activation bytes / remat seconds per policy, with the
+        winner's policy named.  ``None`` when the module declared no
+        remat ladder (no candidate carries a policy)."""
+        win = next((e for e in self.entries if e["status"] == "winner"),
+                   None)
+        if win is None or not win.get("remat"):
+            return None
+
+        def axes(e):
+            return (e.get("strategy"), str(e.get("mesh")), e.get("comm"),
+                    e.get("donate"), e.get("microbatch"))
+
+        policies = {}
+        for e in self.entries:
+            if not e.get("remat") or axes(e) != axes(win):
+                continue
+            m = e.get("modeled") or {}
+            policies[e["remat"]] = {
+                "status": e["status"],
+                "peak_bytes": m.get("peak_bytes"),
+                "act_bytes": m.get("act_bytes"),
+                "remat_seconds": m.get("remat_seconds"),
+                "reason": e.get("reason"),
+            }
+        return {"winner": win["remat"], "policies": policies}
+
     def to_dict(self) -> dict:
         compiled = sum(1 for e in self.entries
                        if e["status"] in ("compiled", "winner")
@@ -64,6 +95,7 @@ class PlanReport:
                           if e["status"] != "pruned"),
             "compiled": compiled,
             "candidates": list(self.entries),
+            "remat": self._remat_summary(),
         }
 
     def summary(self) -> str:
@@ -84,7 +116,8 @@ def make_entry(candidate, status: str, reason: Optional[str] = None,
         entry = candidate.to_dict()
     else:
         entry = {"label": str(candidate), "strategy": None, "mesh": None,
-                 "comm": None, "donate": None, "microbatch": None}
+                 "comm": None, "donate": None, "microbatch": None,
+                 "remat": None}
     entry["status"] = status
     entry["reason"] = reason
     entry["modeled"] = modeled

@@ -9,7 +9,10 @@ otherwise only fail deep inside a planning run:
 2. enumeration sanity: the canonical inventory appears, spmd mesh
    factorizations are exact divisors, statically-infeasible combos are
    pruned with named reasons, labels are unique;
-3. score monotonicity: ``bytes_to_seconds`` is strictly monotone in
+3. remat axis: option resolution (no-ladder collapse, unknown-policy
+   prunes, ``RLT_REMAT_POLICY`` pin), enumeration multiplication with
+   unique labels, and ``remat_terms`` score monotonicity;
+4. score monotonicity: ``bytes_to_seconds`` is strictly monotone in
    bytes and inversely so in bandwidth (the ranking invariant);
 4. report schema: ``PlanReport.to_dict()`` carries every pinned key
    and candidate entries carry the entry schema;
@@ -26,6 +29,8 @@ def _check_config() -> None:
 
     cfg = PlanConfig(topk=2, ici_gbps=42.0, dcn_gbps=3.5,
                      strategies=("ddp", "zero1"), microbatch=(1, 4),
+                     remat=("dots", "off"), hbm_gbps=500.0,
+                     device_tflops=90.0,
                      hbm_budget_bytes=1 << 30, headroom=0.8)
     saved = {k: os.environ.get(k) for k in list(os.environ)
              if k.startswith("RLT_PLAN")}
@@ -43,7 +48,9 @@ def _check_config() -> None:
     assert PlanConfig.resolve(None) == PlanConfig()
     for bad in (dict(topk=-1), dict(ici_gbps=0), dict(headroom=0),
                 dict(headroom=1.5), dict(strategies=("warp",)),
-                dict(microbatch=(0,)), dict(max_candidates=0)):
+                dict(microbatch=(0,)), dict(max_candidates=0),
+                dict(hbm_gbps=0), dict(device_tflops=-1),
+                dict(remat=("",))):
         try:
             PlanConfig(**bad)
         except ValueError:
@@ -79,6 +86,74 @@ def _check_enumeration() -> None:
     _, pruned1p = enumerate_candidates(8, 16, cfg, process_count=1)
     assert any(r.startswith("comm_no_dcn") for _, r in pruned1p)
     print("plan selfcheck: enumeration coverage + pruning reasons OK")
+
+
+def _check_remat_axis() -> None:
+    """Remat-axis invariants: option resolution (no-ladder collapse +
+    named prunes, unknown-policy prunes, env pin), enumeration
+    multiplication with unique labels, and remat_terms score
+    monotonicity (more saved bytes → more peak + traffic seconds, more
+    recompute FLOPs → more seconds, "off" pays no region overhead,
+    microbatching divides residency but not traffic)."""
+    import os
+
+    from ray_lightning_tpu.core.remat import RematProbe, RematSpec
+    from ray_lightning_tpu.plan.candidates import (enumerate_candidates,
+                                                   resolve_remat_options)
+    from ray_lightning_tpu.plan.config import PlanConfig
+    from ray_lightning_tpu.plan.cost import remat_terms
+
+    spec = RematSpec(policies=("off", "dots", "full"), default="off",
+                     apply=lambda p: None,
+                     probe=lambda p, b: None)
+    cfg = PlanConfig()
+    opts, pruned = resolve_remat_options(spec, cfg)
+    assert opts == ("off", "dots", "full") and not pruned, (opts, pruned)
+    opts, pruned = resolve_remat_options(
+        spec, PlanConfig(remat=("dots", "warp")))
+    assert opts == ("dots",), opts
+    assert any(r.startswith("remat_unsupported") for _, r in pruned)
+    opts, pruned = resolve_remat_options(None, PlanConfig(remat=("dots",)))
+    assert opts == ("",), opts
+    assert any(r.startswith("remat_unsupported") for _, r in pruned)
+    saved = os.environ.get("RLT_REMAT_POLICY")
+    try:
+        os.environ["RLT_REMAT_POLICY"] = "full"
+        opts, _ = resolve_remat_options(spec, cfg)
+        assert opts == ("full",), opts
+    finally:
+        if saved is None:
+            os.environ.pop("RLT_REMAT_POLICY", None)
+        else:
+            os.environ["RLT_REMAT_POLICY"] = saved
+
+    flat, _ = enumerate_candidates(8, 16, cfg)
+    swept, _ = enumerate_candidates(8, 16, cfg,
+                                    remat_options=("off", "dots"))
+    assert len(swept) == 2 * len(flat), (len(swept), len(flat))
+    labels = [c.label for c in swept]
+    assert len(set(labels)) == len(labels), "duplicate remat labels"
+    assert any(lb.endswith("rm-dots") for lb in labels)
+
+    def terms(saved_b=1 << 24, flops=1 << 30, policy="dots", mb=1):
+        return remat_terms(RematProbe(saved_bytes=saved_b,
+                                      recompute_flops=flops,
+                                      n_blocks=4, batch=8),
+                           policy, cfg, process_count=1, dp=1,
+                           microbatch=mb)
+
+    act1, sec1 = terms()
+    act2, sec2 = terms(saved_b=2 << 24)
+    assert act2 > act1 and sec2 > sec1, "saved bytes must raise both"
+    _, sec3 = terms(flops=2 << 30)
+    assert sec3 > sec1, "recompute flops must raise seconds"
+    _, sec_off = terms(policy="off", flops=0)
+    _, sec_dots = terms(flops=0)
+    assert sec_dots > sec_off, "'off' must skip the region overhead"
+    act_mb, sec_mb = terms(mb=4)
+    assert act_mb < act1, "microbatching must divide residency"
+    assert sec_mb > sec1, "microbatching must not divide traffic"
+    print("plan selfcheck: remat axis enumeration + score monotonicity OK")
 
 
 def _check_monotonicity() -> None:
@@ -133,6 +208,7 @@ def _check_metric_names() -> None:
 def _main(argv: list) -> int:
     _check_config()
     _check_enumeration()
+    _check_remat_axis()
     _check_monotonicity()
     _check_report_schema()
     _check_metric_names()

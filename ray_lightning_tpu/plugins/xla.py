@@ -258,6 +258,12 @@ class RayXlaPlugin(ExecutionPlugin):
         }
         if SEED_ENV_VAR in os.environ:  # PL_GLOBAL_SEED propagation parity
             env[SEED_ENV_VAR] = os.environ[SEED_ENV_VAR]
+        if os.environ.get("RLT_REMAT_POLICY", "").strip():
+            # model-build remat override (models/gpt.py _remat_policy,
+            # pinned by the planner's remat axis): actor fleets must
+            # build the same program as the driver — ships like the
+            # RLT_COMM*/RLT_MPMD* knobs below
+            env["RLT_REMAT_POLICY"] = os.environ["RLT_REMAT_POLICY"]
         if self.platform:
             env["RLT_PLATFORM"] = self.platform
             env["JAX_PLATFORMS"] = self.platform

@@ -337,6 +337,233 @@ def test_calibration_cache_roundtrip(tmp_path, monkeypatch):
     assert PlanConfig.resolve(None).ici_gbps == 77.0
 
 
+# -- remat axis (PR 12): enumeration, ranking, hand-measured picks ---------
+
+def _gpt(name="tiny", batch_size=BATCH):
+    from ray_lightning_tpu.models.gpt import GPTLightningModule
+    module = GPTLightningModule(name, dataset_size=4 * batch_size,
+                                batch_size=batch_size)
+    module.setup_model()
+    return module
+
+
+def test_remat_axis_enumeration_and_pruning():
+    """A module with a configure_remat() ladder multiplies the
+    candidate space by its policies; one without it keeps the PR-8
+    space and records the requested-but-unsupported axis by name."""
+    from ray_lightning_tpu.plan import resolve_remat_options
+
+    module = _gpt()
+    spec = module.configure_remat()
+    assert spec is not None and spec.default == "off"
+    options, pruned = resolve_remat_options(spec, PlanConfig())
+    assert set(options) == {"off", "full", "dots", "dots_no_batch"}
+    assert not pruned
+    # restriction + unknown policy: known survive, unknown prunes by name
+    options, pruned = resolve_remat_options(
+        spec, PlanConfig(remat=("dots", "warp")))
+    assert options == ("dots",)
+    assert any(r.startswith("remat_unsupported") for _, r in pruned)
+    # no ladder + explicit request -> named prune, axis collapses
+    options, pruned = resolve_remat_options(None, PlanConfig(remat=("dots",)))
+    assert options == ("",)
+    assert any(r.startswith("remat_unsupported") for _, r in pruned)
+    # the axis multiplies enumeration and labels carry the policy
+    cands, _ = enumerate_candidates(8, BATCH, PlanConfig(),
+                                    remat_options=("off", "dots"))
+    by_remat = {c.remat for c in cands}
+    assert by_remat == {"off", "dots"}
+    assert any(c.label.endswith("rm-dots") for c in cands)
+    labels = [c.label for c in cands]
+    assert len(set(labels)) == len(labels)
+
+
+def test_remat_env_pin_and_worker_round_trip(monkeypatch):
+    """RLT_REMAT_POLICY pins the sweep to the forced policy (the model
+    build would override every candidate anyway), ships driver→worker
+    via the plugin env base, and the new RLT_PLAN_* remat knobs
+    round-trip through PlanConfig.worker_env like the PR-8 set."""
+    from ray_lightning_tpu.plan import resolve_remat_options
+    from tests.utils import cpu_plugin
+
+    spec = _gpt().configure_remat()
+    monkeypatch.setenv("RLT_REMAT_POLICY", "dots")
+    options, _ = resolve_remat_options(spec, PlanConfig())
+    assert options == ("dots",)
+    plugin = cpu_plugin(2)
+    assert plugin._worker_env_base()["RLT_REMAT_POLICY"] == "dots"
+    monkeypatch.delenv("RLT_REMAT_POLICY")
+    assert "RLT_REMAT_POLICY" not in plugin._worker_env_base()
+    # planner knob env round-trip (worker_env -> resolve reproduces)
+    cfg = PlanConfig(remat=("dots", "off"), hbm_gbps=500.0,
+                     device_tflops=90.0)
+    for k, v in cfg.worker_env().items():
+        monkeypatch.setenv(k, v)
+    assert PlanConfig.resolve(None) == cfg
+
+
+def test_remat_ranking_deterministic_and_reported():
+    """The remat axis ranks deterministically, the tiny fixture's
+    winner is the hand-measured ``off`` (no memory pressure; the
+    modeled per-region overhead prices the recompute ladder out), and
+    the report's ``remat`` field carries the per-policy modeled
+    HBM/recompute deltas."""
+    module = _gpt()
+    batch = _example_batch(module)
+    r1 = Planner(PlanConfig(topk=0)).plan(module, batch, batch_hint=BATCH)
+    r2 = Planner(PlanConfig(topk=0)).plan(module, batch, batch_hint=BATCH)
+    d1, d2 = r1.to_dict(), r2.to_dict()
+    assert d1["winner"] == d2["winner"] == "ddp[data8]:rm-off"
+    assert [e["label"] for e in d1["candidates"]] \
+        == [e["label"] for e in d2["candidates"]]
+    rm = d1["remat"]
+    assert rm["winner"] == "off"
+    assert set(rm["policies"]) == {"off", "full", "dots", "dots_no_batch"}
+    for policy, row in rm["policies"].items():
+        assert row["peak_bytes"] and row["remat_seconds"] is not None
+    # the deltas the axis exists to expose: "off" saves everything
+    # (max HBM, no recompute seconds beyond traffic), "full" saves
+    # nothing (min HBM)
+    pol = rm["policies"]
+    assert pol["off"]["act_bytes"] > pol["dots"]["act_bytes"] \
+        > pol["full"]["act_bytes"] == 0
+    # planning applied nothing: the module still carries its default
+    assert module.config.remat is False
+
+
+@pytest.mark.parametrize("name,expected", [
+    ("tiny", "off"),
+    ("gpt2-medium", "dots"),
+    ("gpt2-moe-8e", "dots"),
+])
+def test_cost_model_reproduces_hand_measured_picks(name, expected):
+    """The acceptance pin: the cost model alone (topk=0 — nothing
+    compiles) reproduces every hand-measured remat pick documented in
+    models/gpt.py — tiny→off (recompute overhead loses, memory is
+    free), gpt2-medium→dots (+17% steps/s measured walk), and
+    gpt2-moe-8e→dots (beats BOTH full and off; the dots_moe* save
+    lists rank below plain dots exactly as measured)."""
+    module = _gpt(name, batch_size=8)
+    batch = _example_batch(module)
+    cfg = PlanConfig(topk=0, strategies=("ddp",),
+                     hbm_budget_bytes=16 << 30)
+    report = Planner(cfg).plan(module, batch, batch_hint=8)
+    d = report.to_dict()
+    assert d["remat"]["winner"] == expected, d["remat"]
+    assert report.winner_candidate.remat == expected
+    if name == "gpt2-moe-8e":
+        pol = d["remat"]["policies"]
+        assert pol["dots"]["remat_seconds"] \
+            < pol["dots_moe_act"]["remat_seconds"] \
+            < pol["dots_moe"]["remat_seconds"]
+
+
+def test_auto_end_to_end_gpt_applies_remat_winner(tmp_path, seed):
+    """strategy='auto' with a remat-capable module trains to
+    completion, records the remat ladder in its report, and the final
+    params equal the hand-picked equivalent plan (tiny's winner is the
+    module default 'off', so the applied config is unchanged)."""
+    from ray_lightning_tpu.models.gpt import GPTLightningModule
+
+    def gpt_module():
+        return GPTLightningModule("tiny", dataset_size=4 * BATCH,
+                                  batch_size=BATCH)
+
+    auto = _fit_trainer(tmp_path, "auto", strategy="auto",
+                        plan={"topk": 0}, max_steps=3)
+    m_auto = gpt_module()
+    auto.fit(m_auto)
+    assert auto.global_step == 3
+    d = auto._plan_report
+    assert d["winner"] == "ddp[data8]:rm-off"
+    assert d["remat"]["winner"] == "off"
+    assert m_auto.config.remat is False
+    hand = _fit_trainer(tmp_path, "hand", strategy="ddp", max_steps=3)
+    m_hand = gpt_module()
+    hand.fit(m_hand)
+    for a, b in zip(
+            jax.tree_util.tree_leaves(m_auto._trained_variables),
+            jax.tree_util.tree_leaves(m_hand._trained_variables)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+    # a NON-default winner is applied in place: restricting the sweep
+    # to "dots" must reconfigure the module (remat wrap on) and still
+    # train to completion
+    forced = _fit_trainer(tmp_path, "forced", strategy="auto",
+                          plan={"topk": 0, "remat": ("dots",)},
+                          max_steps=2)
+    m_forced = gpt_module()
+    assert m_forced.config.remat is False
+    forced.fit(m_forced)
+    assert forced.global_step == 2
+    assert forced._plan_report["winner"] == "ddp[data8]:rm-dots"
+    assert m_forced.config.remat is True
+    assert m_forced.config.remat_policy == "dots"
+
+
+# -- remat drift guard: modeled activation bytes vs compiled programs ------
+
+@pytest.fixture(scope="module")
+def remat_compiled_peaks():
+    """Compile the tiny-GPT train step (single device, donated) under
+    full / dots / off and yield each program's memory_analysis peak —
+    the measured side of the activation-model drift guard."""
+    from ray_lightning_tpu.models.gpt import GPTLightningModule
+
+    peaks = {}
+    for policy in ("full", "dots", "off"):
+        module = GPTLightningModule("tiny", dataset_size=4 * BATCH,
+                                    batch_size=BATCH)
+        module.configure_remat().apply(policy)
+        module.setup_model()
+        batch = jax.tree_util.tree_map(
+            np.asarray, next(iter(module.train_dataloader())))
+        tx = module.configure_optimizers()
+        abstract = jax.eval_shape(build_init_fn(module, tx),
+                                  jax.random.PRNGKey(0), batch)
+        jitted = jax.jit(build_train_step(module, tx), donate_argnums=0)
+        mem = jitted.lower(abstract, batch).compile().memory_analysis()
+        peaks[policy] = (int(mem.argument_size_in_bytes)
+                         + int(mem.output_size_in_bytes)
+                         + int(mem.temp_size_in_bytes)
+                         - int(mem.alias_size_in_bytes))
+    return peaks
+
+
+def test_remat_drift_modeled_vs_compiled(remat_compiled_peaks):
+    """The activation model can't silently rot: per policy, the
+    modeled saved-activation bytes (core/remat.py probe through
+    plan/cost.py remat_terms) must track the COMPILED programs'
+    memory_analysis peak deltas vs the save-nothing baseline within a
+    calibrated band (measured on this toolchain: off 1.05x, dots
+    0.52x — the model lists residuals at their own dtype while XLA's
+    buffer assignment shares buffers), and the modeled policy ordering
+    must match the compiled one."""
+    from ray_lightning_tpu.plan.cost import remat_terms
+
+    module = _gpt()
+    spec = module.configure_remat()
+    batch = _example_batch(module)
+    cfg = PlanConfig()
+    modeled = {}
+    for policy in ("full", "dots", "off"):
+        probe = spec.probe(policy, batch)
+        act, _seconds = remat_terms(probe, policy, cfg,
+                                    process_count=1, dp=1, microbatch=1)
+        modeled[policy] = act
+    compiled = remat_compiled_peaks
+    # ordering: more saved activations -> higher compiled peak
+    assert modeled["off"] > modeled["dots"] > modeled["full"] == 0
+    assert compiled["off"] > compiled["dots"] > compiled["full"]
+    # calibrated bands on the deltas vs the save-nothing program
+    for policy in ("dots", "off"):
+        measured_delta = compiled[policy] - compiled["full"]
+        ratio = modeled[policy] / measured_delta
+        assert 0.2 <= ratio <= 4.0, (policy, modeled[policy],
+                                     measured_delta)
+
+
 # -- resolve_strategy surface (satellite: docstring/README drift) ----------
 
 def test_resolve_strategy_unknown_name_lists_valid_set():

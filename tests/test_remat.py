@@ -1,0 +1,220 @@
+"""Remat lever (core/remat.py + the ``configure_remat()`` hooks on
+GPT / PipelinedGPT / BERT): policy mapping, probe physics, in-place
+apply, and the invariant that makes the whole axis safe to sweep —
+remat changes scheduling, never math, so every policy trains to the
+same params."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.core import remat as rm
+from ray_lightning_tpu.core.steps import build_init_fn, build_train_step
+from ray_lightning_tpu.models.bert import BertMLMModule
+from ray_lightning_tpu.models.gpt import CONFIGS, GPTLightningModule
+from ray_lightning_tpu.models.pipeline_gpt import PipelinedGPT
+
+BATCH = 8
+
+
+def _example_batch(module):
+    return jax.tree_util.tree_map(
+        np.asarray, next(iter(module.train_dataloader())))
+
+
+def _trained_params(module, steps=2):
+    """Single-device train loop through the real step builder — the
+    lightest full-fidelity path (forward + backward + optimizer)."""
+    module.setup_model()
+    batch = _example_batch(module)
+    tx = module.configure_optimizers()
+    if isinstance(tx, dict):
+        tx = tx["optimizer"]
+    state = jax.jit(build_init_fn(module, tx))(jax.random.PRNGKey(0),
+                                               batch)
+    step = jax.jit(build_train_step(module, tx))
+    for _ in range(steps):
+        state, _metrics = step(state, batch)
+    return state.params
+
+
+def assert_params_equal(a, b, atol=2e-3):
+    """Policies must train to the same params up to bf16 fusion
+    reassociation: recompute changes which ops fuse, bf16 accumulation
+    order inside the regrouped fusions wiggles low bits, and the
+    bf16-RESIDENT params (RLT_BF16_PARAMS default) then round a
+    one-ULP flip on a handful of elements (measured 2/12288 at one
+    ulp ≈ 9.8e-4 after 2 tiny-GPT steps on this toolchain) — never
+    the math itself."""
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x, np.float32),
+                                   np.asarray(y, np.float32),
+                                   rtol=1e-3, atol=atol)
+
+
+# -- policy mapping --------------------------------------------------------
+
+def test_policy_object_mapping_and_errors():
+    for name in rm.POLICY_LADDER + rm.MOE_POLICIES:
+        rm.policy_object(name)   # resolves
+    assert rm.policy_object("full") is None    # jax default: save nothing
+    with pytest.raises(ValueError, match="remat_policy"):
+        rm.policy_object("warp")
+
+
+def test_gpt_remat_policy_env_override(monkeypatch):
+    """models/gpt.py _remat_policy keeps the RLT_REMAT_POLICY
+    per-build override on top of the shared mapping."""
+    from ray_lightning_tpu.models.gpt import _remat_policy
+
+    assert _remat_policy("full") is None
+    monkeypatch.setenv("RLT_REMAT_POLICY", "off")
+    assert _remat_policy("full") is jax.checkpoint_policies\
+        .everything_saveable
+
+
+# -- probe physics ---------------------------------------------------------
+
+def test_gpt_probe_ordering():
+    """More aggressive saving -> more saved bytes; more aggressive
+    recompute -> more backward matmul FLOPs.  ``full`` saves nothing
+    and recomputes every dot; ``dots`` saves every dot output and
+    recomputes none."""
+    module = GPTLightningModule("tiny", dataset_size=4 * BATCH,
+                                batch_size=BATCH)
+    spec = module.configure_remat()
+    batch = _example_batch(module)
+    probes = {p: spec.probe(p, batch) for p in spec.policies}
+    assert probes["off"].saved_bytes > probes["dots"].saved_bytes \
+        > probes["full"].saved_bytes == 0
+    assert probes["full"].recompute_flops > 0
+    assert probes["dots"].recompute_flops == 0
+    assert probes["off"].recompute_flops == 0
+    assert probes["dots_no_batch"].recompute_flops > 0
+    for p in probes.values():
+        assert p.n_blocks == module.config.n_layer
+        assert p.batch == BATCH
+    # probes scale ~linearly in batch (the rescale contract
+    # plan/cost.py remat_terms relies on; a few batch-free residuals —
+    # layernorm stats over [T, C] etc. — keep it from being exact)
+    module2 = GPTLightningModule("tiny", dataset_size=8 * BATCH,
+                                 batch_size=2 * BATCH)
+    double = module2.configure_remat().probe("off", _example_batch(module2))
+    assert 1.9 <= double.saved_bytes / probes["off"].saved_bytes <= 2.0
+
+
+def test_apply_is_in_place_and_clone_safe():
+    """apply() reconfigures THE module it was created from (resets the
+    materialized model); a copy.copy clone's own spec leaves the
+    original untouched — the planner's per-candidate isolation."""
+    import copy
+
+    module = GPTLightningModule("gpt2-medium")
+    spec = module.configure_remat()
+    assert spec.default == "dots"
+    module.setup_model()
+    spec.apply("full")
+    assert module.config.remat and module.config.remat_policy == "full"
+    assert module.model is None          # next setup_model rebuilds
+    spec.apply("off")
+    assert module.config.remat is False
+    clone = copy.copy(module)
+    clone.configure_remat().apply("dots_no_batch")
+    assert clone.config.remat_policy == "dots_no_batch"
+    assert module.config.remat is False  # original untouched
+    with pytest.raises(ValueError, match="ladder"):
+        spec.apply("warp")
+    # MoE configs extend the ladder with the checkpoint_name save lists
+    moe_spec = GPTLightningModule("gpt2-moe-8e").configure_remat()
+    assert "dots_moe" in moe_spec.policies
+    # dense configs don't
+    assert "dots_moe" not in spec.policies
+
+
+def test_boring_model_declares_no_ladder():
+    from ray_lightning_tpu.models.boring import BoringModel
+    assert BoringModel().configure_remat() is None
+
+
+# -- remat never changes math ----------------------------------------------
+
+def test_gpt_policy_parity():
+    """Every policy trains tiny-GPT to the same params: remat is a
+    scheduling decision (what to save vs recompute), never a numerics
+    one — the property that makes the planner free to sweep it."""
+    reference = None
+    for policy in ("off", "full", "dots"):
+        module = GPTLightningModule("tiny", dataset_size=4 * BATCH,
+                                    batch_size=BATCH)
+        module.configure_remat().apply(policy)
+        params = _trained_params(module)
+        if reference is None:
+            reference = params
+        else:
+            assert_params_equal(reference, params)
+
+
+def test_pipeline_gpt_policy_lever_and_parity():
+    """The MPMD/pipeline family has the full ladder now (was a
+    boolean): policies apply to the scanned stage_fn, parity holds
+    across them, and the configure_mpmd() stage program carries the
+    checkpoint so MPMD stages can trade stash memory for recompute."""
+    cfg = dataclasses.replace(CONFIGS["tiny"])
+    reference = None
+    for policy in ("off", "full", "dots"):
+        module = PipelinedGPT(cfg, n_microbatches=2,
+                              dataset_size=4 * BATCH, batch_size=BATCH)
+        spec = module.configure_remat()
+        assert spec.policies == rm.POLICY_LADDER
+        spec.apply(policy)
+        params = _trained_params(module)
+        if reference is None:
+            reference = params
+        else:
+            assert_params_equal(reference, params)
+    # the MPMD stage_fn inherits the lever: a remat'd config's stage
+    # program contains the checkpoint region, an off config's doesn't
+    def stage_jaxpr(policy):
+        module = PipelinedGPT(cfg, dataset_size=4 * BATCH,
+                              batch_size=BATCH)
+        module.configure_remat().apply(policy)
+        mspec = module.configure_mpmd()
+        h = jnp.zeros((2, cfg.block_size, cfg.n_embd), cfg.dtype)
+        layer = jax.eval_shape(
+            lambda k: module._block.init(k, h, True)["params"],
+            jax.random.PRNGKey(0))
+        return str(jax.make_jaxpr(mspec.stage_fn)(layer, h))
+    assert "remat" in stage_jaxpr("dots")
+    assert "remat" not in stage_jaxpr("off")
+
+
+def test_bert_ladder_and_parity():
+    """BERT gained the lever (BertConfig.remat/remat_policy were
+    absent pre-PR-12): the spec covers the generic ladder, probes see
+    the encoder layers, and parity holds across policies (the MLM
+    mask rides the state PRNG, identical across runs)."""
+    probe_module = BertMLMModule("tiny", batch_size=BATCH,
+                                 train_size=4 * BATCH)
+    spec = probe_module.configure_remat()
+    assert spec.policies == rm.POLICY_LADDER and spec.default == "off"
+    probes = {p: spec.probe(p, _example_batch(probe_module))
+              for p in ("off", "dots", "full")}
+    assert probes["off"].saved_bytes > probes["dots"].saved_bytes > 0
+    assert probes["full"].recompute_flops > 0
+    reference = None
+    for policy in ("off", "dots"):
+        module = BertMLMModule("tiny", batch_size=BATCH,
+                               train_size=4 * BATCH)
+        module.configure_remat().apply(policy)
+        params = _trained_params(module)
+        if reference is None:
+            reference = params
+        else:
+            assert_params_equal(reference, params)
