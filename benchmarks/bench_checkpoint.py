@@ -33,6 +33,12 @@ Two elastic-plane legs (PR 7) join the same JSON line:
   ``rlt_snapshot_stall_seconds_total`` / ``rlt_snapshot_seconds_total``
   sums, so "async snapshots add bounded stall" is a number, not a
   claim.
+- **elastic_recovery** (``--recovery-steps N``, default 8, PR 13): a
+  2-worker ZeRO-1 chaos fit loses rank 1 mid-run, once with parity
+  redundancy on (zero-replay reconstruct-and-continue) and once off
+  (snapshot replay) — time-to-recover, replayed steps, parity-overhead
+  bytes/step and the snapshot-restore count per mode, so "parity buys
+  zero replay for k x shard bytes per cadence" is one JSON diff.
 
 Defaults to the gpt2-small and gpt2-medium configs (the driver runs
 this on TPU hosts); ``--configs tiny`` keeps CPU smoke runs tractable.
@@ -236,6 +242,64 @@ def _bench_snapshot(steps: int, workdir: str) -> dict:
     }
 
 
+def _bench_elastic_recovery(steps: int, workdir: str) -> list:
+    """Zero-replay vs replay, measured (ISSUE 13): a 2-worker ZeRO-1
+    chaos fit loses rank 1 mid-run, once with parity redundancy on and
+    once off.  Emits one row per mode with time-to-recover (driver
+    route decision + the resumed attempt's time-to-first-step), the
+    parity overhead bytes/step that bought it, and the resume step —
+    the parity row resumes at the kill step with ZERO snapshot
+    restores, the replay row pays the rewind to the last durable
+    snapshot."""
+    import optax
+
+    from ray_lightning_tpu import RayXlaPlugin, Trainer
+    from ray_lightning_tpu.models import BoringModel
+
+    class AdamBoring(BoringModel):
+        def configure_optimizers(self):
+            return optax.adam(0.05)
+
+    kill = max(2, steps - 3)
+    rows = []
+    for redundancy in (1, 0):
+        snap = os.path.join(workdir, f"elastic_r{redundancy}")
+        trainer = Trainer(
+            max_epochs=10**6, max_steps=steps, limit_val_batches=0,
+            num_sanity_val_steps=0, enable_checkpointing=False, seed=0,
+            log_every_n_steps=10**6,
+            default_root_dir=os.path.join(workdir, f"root_r{redundancy}"),
+            plugins=[RayXlaPlugin(
+                2, platform="cpu", strategy="zero1",
+                worker_env={"RLT_FAULT": f"kill:rank=1,step={kill}"})],
+            elastic={"snapshot_every_n_steps": 2, "snapshot_dir": snap,
+                     "max_restarts": 2, "redundancy": redundancy})
+        t0 = time.monotonic()
+        trainer.fit(AdamBoring(dataset_length=max(64, 4 * steps),
+                               batch_size=2))
+        wall = time.monotonic() - t0
+        rep = trainer._elastic_report or {}
+        rows.append({
+            "config": "boring",
+            "path": "elastic_recovery",
+            "redundancy": redundancy,
+            "recovery": rep.get("recovery"),
+            "steps": steps,
+            "kill_step": kill,
+            "resumed_step": rep.get("resumed_step"),
+            "replayed_steps": kill - (rep.get("resumed_step") or 0),
+            "wall_seconds": round(wall, 3),
+            "recovery_seconds": round(rep.get("recovery_seconds", 0.0)
+                                      or 0.0, 3),
+            "recovery_decision_seconds": round(
+                rep.get("recovery_decision_seconds", 0.0) or 0.0, 4),
+            "parity_bytes_per_step": int(
+                (rep.get("parity_bytes") or 0) / max(1, kill)),
+            "snapshot_restores": rep.get("snapshot_restores", 0),
+        })
+    return rows
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--configs", default="gpt2-small,gpt2-medium",
@@ -247,6 +311,9 @@ def main(argv=None) -> int:
     ap.add_argument("--no-reshard", dest="reshard", action="store_false")
     ap.add_argument("--snapshot-steps", type=int, default=8,
                     help="steps for the async-snapshot leg (0 = skip)")
+    ap.add_argument("--recovery-steps", type=int, default=8,
+                    help="steps for the 2-worker zero-replay recovery "
+                         "leg (0 = skip; spawns CPU subprocess workers)")
     args = ap.parse_args(argv)
 
     # the reshard leg needs >= 4 devices; on a forced-CPU run stand up
@@ -286,6 +353,9 @@ def main(argv=None) -> int:
     if args.snapshot_steps > 0:
         with tempfile.TemporaryDirectory(prefix="rlt_ckpt_snap_") as d:
             rows.append(_bench_snapshot(args.snapshot_steps, d))
+    if args.recovery_steps > 0:
+        with tempfile.TemporaryDirectory(prefix="rlt_ckpt_rec_") as d:
+            rows.extend(_bench_elastic_recovery(args.recovery_steps, d))
     print(json.dumps({"metric": "checkpoint_io", "unit": "seconds",
                       "rows": rows}))
     return 0

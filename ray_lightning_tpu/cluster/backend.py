@@ -84,6 +84,19 @@ class ActorHandle:
         capture worker output (real Ray surfaces logs its own way)."""
         return ""
 
+    def harvest_escrow(self, timeout: float = 15.0) -> Optional[dict]:
+        """Best-effort fetch of the worker's recovery escrow
+        (cluster/worker_state.py, deposited by the elastic parity tick)
+        WITHOUT going through the main-thread call queue — at harvest
+        time the survivor is usually wedged in a collective whose peer
+        just died.  The builtin backend answers from the worker's
+        frame-reader thread; Ray from a concurrent actor method.  None
+        when the backend cannot harvest, the worker never escrowed, or
+        the fetch times out — the elastic driver then falls back to
+        snapshot replay."""
+        del timeout
+        return None
+
 
 class ClusterBackend:
     """Actor lifecycle + object transport + worker→driver queue."""

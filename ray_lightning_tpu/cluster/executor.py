@@ -53,3 +53,17 @@ class RLTExecutor:
 
     def ping(self) -> str:
         return "pong"
+
+    # -- peer channel + recovery escrow (Ray transport) -------------------
+    # On the builtin backend peer frames and escrow harvests ride the
+    # worker's frame-reader thread; under Ray they arrive as CONCURRENT
+    # actor method calls (the plugin creates executors with
+    # max_concurrency >= 2), so both work while the main call computes.
+
+    def __rlt_peer_deliver__(self, item: dict) -> None:
+        from ray_lightning_tpu.cluster import worker_state
+        worker_state.peer_push(item)
+
+    def __rlt_escrow_export__(self) -> Optional[dict]:
+        from ray_lightning_tpu.cluster import worker_state
+        return worker_state.escrow_export()

@@ -185,6 +185,31 @@ class LocalActorHandle(ActorHandle):
             self._fail_pending(RemoteActorError(str(e)))
         return fut
 
+    def harvest_escrow(self, timeout: float = 15.0):
+        """Recovery-escrow fetch over a dedicated ``escrow`` frame: the
+        worker's frame-reader thread answers it directly
+        (worker_main.py), so a survivor wedged inside a dead collective
+        still yields its escrowed state.  The reply rides the normal
+        ``result`` routing via a pending future."""
+        if self._dead or self._conn is None:
+            return None
+        fut = Future()
+        call_id = uuid.uuid4().hex
+        with self._lock:
+            self._pending[call_id] = fut
+        try:
+            self._conn.send({"type": "escrow", "call_id": call_id})
+        except (ConnectionError, OSError):
+            with self._lock:
+                self._pending.pop(call_id, None)
+            return None
+        try:
+            return fut.result(timeout)
+        except BaseException:   # noqa: BLE001 - harvest is best-effort
+            with self._lock:
+                self._pending.pop(call_id, None)
+            return None
+
     def log_tail(self, max_bytes: int = 4096) -> str:
         """Raw tail of the captured worker log (no banner — the flight
         recorder stores it as its own JSON field)."""

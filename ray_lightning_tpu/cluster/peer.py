@@ -3,7 +3,8 @@
 The cluster backends' third data plane, next to actor RPC
 (driver→worker) and the queue (worker→driver): tagged payloads
 travelling BETWEEN workers.  The MPMD pipeline's activation exchange
-(ray_lightning_tpu/mpmd/channel.py) is the first consumer.
+(ray_lightning_tpu/mpmd/channel.py) and the elastic plane's parity
+ticks (elastic/redundancy.py) are the consumers.
 
 Transport per backend:
 
@@ -24,10 +25,22 @@ blocking store — out-of-order delivery is harmless by construction (a
 receive blocks on ITS tag), and a receive that outlives its timeout
 raises :class:`PeerTimeout` naming the waiter and the missing payload
 instead of hanging the fleet.
+
+**Retry/backoff** (``RLT_PEER_RETRIES`` / ``RLT_PEER_BACKOFF_S``):
+by default a receive makes exactly ONE attempt of ``timeout`` seconds
+(today's behavior).  With ``RLT_PEER_RETRIES=N`` it re-waits up to N
+more times with exponential backoff between attempts, emitting a
+``peer_retry`` span per re-attempt so the crash flight recorder shows
+the retry trail next to the rank's last steps; the final
+:class:`PeerTimeout` names the attempt count.  Retries absorb
+transient delivery loss (a dropped frame whose sender re-emits, a
+driver-hop hiccup) without changing the dead-peer bound for
+single-attempt callers.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any
@@ -35,6 +48,24 @@ from typing import Any
 
 class PeerTimeout(RuntimeError):
     """A worker waited longer than the dead-peer bound for a payload."""
+
+
+ENV_PEER_RETRIES = "RLT_PEER_RETRIES"
+ENV_PEER_BACKOFF_S = "RLT_PEER_BACKOFF_S"
+
+
+def _retry_policy() -> tuple:
+    """(extra_attempts, base_backoff_s) from the env; (0, 0.0) —
+    today's single-attempt behavior — unless explicitly raised."""
+    try:
+        retries = int(os.environ.get(ENV_PEER_RETRIES, "0") or 0)
+    except ValueError:
+        retries = 0
+    try:
+        backoff = float(os.environ.get(ENV_PEER_BACKOFF_S, "0.05") or 0.05)
+    except ValueError:
+        backoff = 0.05
+    return max(0, retries), max(0.0, backoff)
 
 
 class Mailbox:
@@ -49,19 +80,42 @@ class Mailbox:
             self._items[tag] = payload
             self._cond.notify_all()
 
-    def take(self, tag: tuple, timeout: float, *, who: str = "worker",
-             src: str = "peer") -> Any:
+    def _take_one(self, tag: tuple, timeout: float):
+        """One bounded wait; returns (found, payload)."""
         deadline = time.monotonic() + timeout
         with self._cond:
             while tag not in self._items:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    raise PeerTimeout(
-                        f"{who} timed out after {timeout:.1f}s waiting "
-                        f"for peer payload {tag!r} from {src} — peer "
-                        f"dead or schedules desynchronized")
+                    return False, None
                 self._cond.wait(remaining)
-            return self._items.pop(tag)
+            return True, self._items.pop(tag)
+
+    def take(self, tag: tuple, timeout: float, *, who: str = "worker",
+             src: str = "peer") -> Any:
+        retries, backoff = _retry_policy()
+        for attempt in range(retries + 1):
+            found, payload = self._take_one(tag, timeout)
+            if found:
+                return payload
+            if attempt >= retries:
+                break
+            # record the retry in the span stream (the flight recorder
+            # shows the trail) and the metrics plane; both no-op when
+            # telemetry is off
+            from ray_lightning_tpu.telemetry import metrics as _metrics
+            from ray_lightning_tpu.telemetry.spans import span
+            reg = _metrics.get_registry()
+            if reg is not None:
+                reg.counter("rlt_peer_retries_total").inc()
+            delay = backoff * (2 ** attempt)
+            with span("peer_retry", tag=repr(tag), attempt=attempt + 1,
+                      of=retries, backoff_s=delay):
+                time.sleep(delay)
+        raise PeerTimeout(
+            f"{who} timed out after {retries + 1} attempt(s) of "
+            f"{timeout:.1f}s waiting for peer payload {tag!r} from "
+            f"{src} — peer dead or schedules desynchronized")
 
     def __len__(self):
         with self._cond:

@@ -153,6 +153,21 @@ class RayActorHandle(ActorHandle):
         except Exception:
             return None
 
+    def harvest_escrow(self, timeout: float = 15.0):
+        """Recovery-escrow fetch via the executor's concurrent
+        ``__rlt_escrow_export__`` method — the actor must have been
+        created with ``max_concurrency >= 2`` (the plugin does) so the
+        call runs beside a wedged main call.  None on any failure: the
+        elastic driver then falls back to snapshot replay."""
+        try:
+            ref = self._actor.__rlt_escrow_export__.remote()
+            ready, _ = ray.wait([ref], timeout=timeout)
+            if not ready:
+                return None
+            return ray.get(ready[0])
+        except Exception:
+            return None
+
     def log_tail(self, max_bytes: int = 4096) -> str:
         """Best-effort worker-log forensics for the crash flight
         recorder (telemetry/flight.py): the state API's log fetch when

@@ -91,6 +91,18 @@ def main() -> int:
                 return
             if msg.get("type") == "peer":
                 worker_state.peer_push(msg["item"])
+            elif msg.get("type") == "escrow":
+                # recovery-escrow harvest (elastic/redundancy.py):
+                # answered HERE, on the reader thread, because at
+                # harvest time the main thread is typically wedged in a
+                # collective whose peer just died — the escrow cell is
+                # the survivors' state the driver must not lose
+                try:
+                    _conn.send({"type": "result",
+                                "call_id": msg["call_id"], "ok": True,
+                                "value": worker_state.escrow_export()})
+                except (ConnectionError, OSError):
+                    pass
             else:
                 inbox.put(msg)
 

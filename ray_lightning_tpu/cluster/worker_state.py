@@ -9,13 +9,21 @@ through here.
 
 from __future__ import annotations
 
-from typing import Optional
+import logging
+import threading
+from typing import Any, Optional
 
 from ray_lightning_tpu.cluster.peer import Mailbox
 from ray_lightning_tpu.cluster.protocol import Connection
 
+_log = logging.getLogger(__name__)
+
 _conn: Optional[Connection] = None
 _peer_mailbox = Mailbox()
+
+_escrow_lock = threading.Lock()
+_escrow: Optional[dict] = None
+_peer_drop = 0
 
 
 def set_conn(conn: Optional[Connection]) -> None:
@@ -45,8 +53,36 @@ def peer_mailbox() -> Mailbox:
 
 
 def peer_push(item: dict) -> None:
-    """Deposit an inbound peer payload ``{"tag": ..., "wire": ...}``."""
+    """Deposit an inbound peer payload ``{"tag": ..., "wire": ...}``.
+    An armed ``peerdrop`` fault (elastic/faults.py) swallows the frame
+    here — the lossy-fabric chaos case, receiver-side so both backends'
+    transports are covered."""
+    global _peer_drop
+    with _escrow_lock:
+        if _peer_drop > 0:
+            _peer_drop -= 1
+            remaining = _peer_drop
+            dropped = True
+        else:
+            dropped = False
+    if dropped:
+        _log.warning("peerdrop fault: dropping inbound peer frame "
+                     "%r (%d more to drop)", item.get("tag"), remaining)
+        return
     _peer_mailbox.put(tuple(item["tag"]), item["wire"])
+
+
+def arm_peer_drop(count: int) -> None:
+    """Arm the ``peerdrop`` chaos fault: swallow the next ``count``
+    inbound peer frames on this process."""
+    global _peer_drop
+    with _escrow_lock:
+        _peer_drop += max(0, int(count))
+
+
+def peer_drop_pending() -> int:
+    with _escrow_lock:
+        return _peer_drop
 
 
 def peer_send(dst_actor_name: str, item: dict) -> None:
@@ -69,3 +105,40 @@ def peer_send(dst_actor_name: str, item: dict) -> None:
             "no Ray runtime)")
     ray.get(ray.get_actor(dst_actor_name).__rlt_peer_deliver__
             .remote(item))
+
+
+# -- recovery escrow (elastic/redundancy.py) --------------------------------
+
+
+def escrow_set(item: Optional[dict]) -> None:
+    """Deposit this process's latest recovery escrow (the elastic
+    parity tick).  One cell, latest wins — recovery only ever wants the
+    most recent completed tick."""
+    global _escrow
+    with _escrow_lock:
+        _escrow = item
+
+
+def escrow_export() -> Optional[dict]:
+    """The latest escrow, served to the driver's harvest — called from
+    the frame-reader thread (worker_main) or a concurrent Ray method,
+    so it must never touch the (possibly wedged) main thread."""
+    with _escrow_lock:
+        return _escrow
+
+
+def escrow_clear() -> None:
+    escrow_set(None)
+
+
+def reset_for_tests() -> None:
+    """Clear process-global chaos/escrow state between in-process
+    tests."""
+    global _peer_drop
+    with _escrow_lock:
+        _peer_drop = 0
+    escrow_clear()
+
+
+# typing helper for the escrow payload (driver-side)
+Escrow = dict[str, Any]

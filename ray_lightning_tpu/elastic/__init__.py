@@ -1,5 +1,5 @@
-"""Elastic plane: reshardable checkpoints, async snapshots, and
-shrink-to-continue fault tolerance.
+"""Elastic plane: reshardable checkpoints, async snapshots, parity
+redundancy, and two-tier fault tolerance.
 
 Preemptible TPU pools are the realistic deployment for this system:
 workers WILL disappear mid-run.  The failure-detection half landed in
@@ -7,19 +7,27 @@ PR 1 (the heartbeat watchdog names a dead or wedged rank); this package
 is the reaction:
 
 - ``snapshot.py`` — async per-step sharded snapshots off the critical
-  path, with bounded backpressure and cost instruments
+  path, with bounded backpressure, failure hardening (a flaky save is
+  counted and retried, not fatal) and cost instruments
   (``rlt_snapshot_*``) on ``/metrics``;
 - ``reshard.py`` — restore an orbax per-shard save taken on N hosts
   onto M hosts (any strategy), re-bucketing the comm plane's
   ``[world, ...]`` error-feedback residual instead of blindly
   reloading it;
-- ``driver.py`` — the shrink-to-continue loop: a dead rank tears down
-  the fleet, the driver rebuilds it with the survivors, re-runs
-  rendezvous, reshard-restores the latest snapshot, rescales the
-  per-worker batch so the global batch is preserved, and continues to
-  ``max_steps``;
-- ``faults.py`` — deterministic fault injection
-  (kill-rank-k-at-step-s / wedge / slow) for chaos tests and benches;
+- ``redundancy.py`` — parity-redundant optimizer state: each rank XORs
+  k neighbor ranks' ZeRO-1 partitions into a parity block over the
+  worker↔worker peer channel, escrowing its own state host-side so a
+  single-rank loss is reconstructed in-fleet and training continues
+  from the *current* step, snapshot-free;
+- ``driver.py`` — the recovery router: single-rank loss with parity on
+  routes to reconstruct-and-continue; multi-rank loss or parity-off
+  falls back to shrink-to-continue snapshot replay (rebuild the fleet
+  with the survivors, reshard-restore the latest snapshot, rescale the
+  per-worker batch, continue to ``max_steps``), reported as
+  ``recovery: parity|replay|scratch``;
+- ``faults.py`` — deterministic fault injection (kill / wedge / slow /
+  snapkill / peerdrop; ``RLT_FAULT`` takes a semicolon-separated list)
+  for chaos tests and benches;
 - ``config.py`` — ``Trainer(elastic=...)`` / ``RLT_ELASTIC*`` knobs.
 
 Only the light, jax-free pieces import here (config + faults): the
@@ -33,6 +41,7 @@ from ray_lightning_tpu.elastic.faults import (  # noqa: F401
     FaultSpec,
     maybe_injector_from_env,
     parse_fault,
+    parse_faults,
 )
 
 __all__ = [
@@ -41,4 +50,5 @@ __all__ = [
     "FaultSpec",
     "maybe_injector_from_env",
     "parse_fault",
+    "parse_faults",
 ]

@@ -16,8 +16,10 @@ CompileCacheConfig / CommPolicy):
   otherwise);
 - ``RLT_ELASTIC=1`` (+ ``RLT_ELASTIC_EVERY=50``, ``RLT_ELASTIC_DIR``,
   ``RLT_ELASTIC_MAX_RESTARTS``, ``RLT_ELASTIC_MIN_WORKERS``,
-  ``RLT_ELASTIC_KEEP``, ``RLT_ELASTIC_PRESERVE_BATCH``) — env knobs,
-  read when the Trainer arg is ``None``.
+  ``RLT_ELASTIC_KEEP``, ``RLT_ELASTIC_PRESERVE_BATCH``,
+  ``RLT_ELASTIC_REDUNDANCY``, ``RLT_ELASTIC_REDUNDANCY_EVERY``,
+  ``RLT_ELASTIC_SNAPSHOT_FAILURES``) — env knobs, read when the
+  Trainer arg is ``None``.
 
 The resolved config is a frozen dataclass pickled driver→worker with
 the trainer; the env knobs additionally round-trip through
@@ -63,6 +65,19 @@ class ElasticConfig:
         a shrink — the resume-with-fewer-workers redistribution the
         checkpoint re-shard already does for state (core/trainer.py).
     max_to_keep: snapshot retention (orbax ``max_to_keep``).
+    redundancy: parity-redundant optimizer state (elastic/redundancy.py):
+        each rank XORs the ZeRO-1 optimizer-state partitions of this
+        many neighbor ranks into a parity block, enabling zero-replay
+        reconstruct-and-continue on a single-rank loss.  0 (default)
+        disables parity; snapshot replay is then the only recovery.
+    redundancy_every_n_steps: parity refresh cadence piggybacked on the
+        step — recovery resumes from the last completed tick, so 1
+        (default) makes single-loss recovery exact at the current step
+        while larger values amortize the ``k x shard_bytes`` wire cost.
+    max_snapshot_failures: how many CONSECUTIVE async-snapshot save
+        failures to absorb (counted, retried next cadence tick) before
+        raising — a flaky snapshot target must not kill training, a
+        permanently broken one must not fail silently.
     """
 
     enabled: bool = False
@@ -72,6 +87,9 @@ class ElasticConfig:
     min_workers: int = 1
     preserve_global_batch: bool = True
     max_to_keep: Optional[int] = 2
+    redundancy: int = 0
+    redundancy_every_n_steps: int = 1
+    max_snapshot_failures: int = 3
 
     def __post_init__(self):
         if self.snapshot_every_n_steps < 0:
@@ -82,6 +100,13 @@ class ElasticConfig:
             raise ValueError("elastic min_workers must be >= 1")
         if self.max_to_keep is not None and self.max_to_keep < 1:
             raise ValueError("elastic max_to_keep must be >= 1 or None")
+        if self.redundancy < 0:
+            raise ValueError("elastic redundancy must be >= 0")
+        if self.redundancy_every_n_steps < 1:
+            raise ValueError(
+                "elastic redundancy_every_n_steps must be >= 1")
+        if self.max_snapshot_failures < 1:
+            raise ValueError("elastic max_snapshot_failures must be >= 1")
 
     # -- construction ----------------------------------------------------
 
@@ -110,6 +135,12 @@ class ElasticConfig:
             preserve_global_batch=_env_flag(
                 "RLT_ELASTIC_PRESERVE_BATCH", True),
             max_to_keep=int(keep_raw) if keep_raw else 2,
+            redundancy=int(
+                os.environ.get("RLT_ELASTIC_REDUNDANCY", "0") or 0),
+            redundancy_every_n_steps=int(
+                os.environ.get("RLT_ELASTIC_REDUNDANCY_EVERY", "1") or 1),
+            max_snapshot_failures=int(
+                os.environ.get("RLT_ELASTIC_SNAPSHOT_FAILURES", "3") or 3),
         )
 
     # -- env round-trip --------------------------------------------------
@@ -127,6 +158,11 @@ class ElasticConfig:
             "RLT_ELASTIC_MIN_WORKERS": str(self.min_workers),
             "RLT_ELASTIC_PRESERVE_BATCH":
                 "1" if self.preserve_global_batch else "0",
+            "RLT_ELASTIC_REDUNDANCY": str(self.redundancy),
+            "RLT_ELASTIC_REDUNDANCY_EVERY":
+                str(self.redundancy_every_n_steps),
+            "RLT_ELASTIC_SNAPSHOT_FAILURES":
+                str(self.max_snapshot_failures),
         }
         if self.snapshot_dir:
             env["RLT_ELASTIC_DIR"] = self.snapshot_dir

@@ -1,39 +1,50 @@
-"""Shrink-to-continue: the driver-side reaction to a lost worker.
+"""Two-tier recovery: the driver-side reaction to a lost worker.
 
 The reference's failure story (SURVEY.md §5) ends at "raise on the
 driver"; the elastic driver goes the rest of the way.  When a fit
 attempt fails because a rank *died* (process gone / connection lost /
 heartbeat hard-timeout — NOT a deterministic user exception, which
-still propagates), the driver:
+still propagates), the driver routes between two recovery tiers:
 
-1. tears down the surviving actors (the plugin's normal teardown —
-   every attempt gets a fresh fleet, so a wedged-but-alive rank is
-   removed the same way a dead one is);
-2. shrinks ``plugin.num_workers`` by the number of dead ranks (at
-   least one), bounded by ``min_workers``/``max_restarts``;
-3. finds the latest durable elastic snapshot (orbax only lists
-   committed steps, so a save the dead fleet never finalized is
-   invisible) and points the resume at it — falling back to the
-   original ``ckpt_path`` (or a from-scratch restart) when no snapshot
-   landed;
-4. re-runs the attempt: fresh actors, fresh PJRT rendezvous on the new
-   world size, reshard-restore into the new mesh
-   (elastic/reshard.py), per-worker batch rescaled so the global batch
-   is preserved (``Trainer._elastic_rescale_loader``), training
-   continuing to ``max_steps``.  Recompiles for the new topology
-   warm-start through the persistent compile cache (compile/) — the
-   topology namespace may be cold but the driver's cache dir survives
-   the fleet.
+**Tier 1 — reconstruct-and-continue (zero replay).**  With parity
+redundancy on (``ElasticConfig(redundancy=k)``) and exactly ONE dead
+rank, the survivors' recovery escrows — harvested by the plugin from
+each worker's frame-reader thread BEFORE teardown, so a wedged main
+thread cannot withhold them — carry everything the dead rank took with
+it: the dead ZeRO-1 optimizer shard is recomputed from its holder's
+parity block XOR the other covered members' escrowed shards
+(elastic/redundancy.py :func:`~ray_lightning_tpu.elastic.redundancy.\
+build_recovery`), the fleet reshards to N-1, and the next attempt
+restores the assembled in-memory state at the escrowed (current) step
+— the snapshot directory is never read.
 
-``rlt_restarts_total`` and the per-rank ``rlt_worker_alive`` gauges
-(telemetry/aggregator.py) put the shrink on ``/metrics`` so dashboards
-see fleet health, not just driver-log text.
+**Tier 2 — snapshot replay.**  Multi-rank loss, parity off, or any gap
+in the escrow set (a survivor that never completed a tick, diverging
+tick steps) falls back to the PR 7 path: find the latest durable
+elastic snapshot (orbax only lists committed steps, so a save the dead
+fleet never finalized — the ``snapkill`` chaos case — is invisible)
+and reshard-restore it; with no snapshot at all, restart from the
+original ``ckpt_path`` or from scratch.
+
+Either way the attempt re-runs with a fresh fleet: new actors, fresh
+PJRT rendezvous on the new world size, per-worker batch rescaled so
+the global batch is preserved (``Trainer._elastic_rescale_loader``),
+training continuing to ``max_steps``.  Recompiles for the new topology
+warm-start through the persistent compile cache (compile/).
+
+The route taken lands everywhere a postmortem looks:
+``trainer._elastic_report["recovery"]`` (``parity|replay|scratch``),
+the classified-death flight dumps (``recovery=...`` in the cause
+line), and the driver-side ``rlt_recovery_mode`` /
+``rlt_recovery_seconds`` series next to ``rlt_restarts_total`` and the
+per-rank ``rlt_worker_alive`` gauges on ``/metrics``.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import time
 from typing import Optional
 
 from ray_lightning_tpu.telemetry.aggregator import WorkerHeartbeatTimeout
@@ -59,14 +70,16 @@ def _restartable(err: BaseException, dead_ranks: list) -> bool:
     return any(m in msg for m in _DEATH_MARKERS)
 
 
-def _dump_flights(plugin, err: BaseException, dead_ranks: list) -> None:
+def _dump_flights(plugin, err: BaseException, dead_ranks: list,
+                  recovery: Optional[str] = None) -> None:
     """Black-box dumps at death-classification time (telemetry/
-    flight.py): the classified cause lands in ``flight_<rank>.json``
-    next to each dead rank's last spans/heartbeats, so the postmortem
-    starts from evidence instead of the silent gap a torn-down fleet
-    otherwise leaves.  Falls back to every known rank when the probe
-    could not name the dead one (the cause still says why).  No-op
-    without telemetry; never raises into failure handling."""
+    flight.py): the classified cause AND the chosen recovery route land
+    in ``flight_<rank>.json`` next to each dead rank's last spans/
+    heartbeats, so the postmortem starts from evidence instead of the
+    silent gap a torn-down fleet otherwise leaves.  Falls back to every
+    known rank when the probe could not name the dead one (the cause
+    still says why).  No-op without telemetry; never raises into
+    failure handling."""
     agg = getattr(plugin, "_telemetry_agg", None)
     if agg is None:
         return
@@ -74,6 +87,8 @@ def _dump_flights(plugin, err: BaseException, dead_ranks: list) -> None:
         cause = (f"elastic death classification: {type(err).__name__}: "
                  f"{str(err).splitlines()[0][:300]}"
                  f" (dead ranks {dead_ranks or 'unknown'})")
+        if recovery is not None:
+            cause += f" recovery={recovery}"
         ranks = dead_ranks or agg.flight.ranks()
         agg.dump_flights([r for r in ranks if r >= 0], cause)
     except Exception:
@@ -94,17 +109,56 @@ def latest_snapshot_step(directory: str) -> Optional[int]:
         ckpt.close()
 
 
+def _route_recovery(plugin, trainer, cfg, dead: list,
+                    snap_dir: str, orig_ckpt: Optional[str]) -> dict:
+    """Choose the recovery tier for one classified failure.
+
+    Returns ``{"mode", "package", "resume", "step", "why"}`` — mode is
+    ``parity`` (in-memory package attached), ``replay`` (a snapshot or
+    the original ckpt_path to restore), or ``scratch``.
+    """
+    from ray_lightning_tpu.elastic import redundancy
+
+    if cfg.redundancy > 0 and len(dead) == 1:
+        escrows = dict(getattr(plugin, "_last_escrows", None) or {})
+        package, why = redundancy.build_recovery(
+            escrows, dead[0], plugin.num_workers, cfg.redundancy)
+        if package is not None:
+            return {"mode": "parity", "package": package, "resume": None,
+                    "step": package["step"], "why": None}
+        _log.warning("elastic: parity recovery unavailable (%s); "
+                     "falling back to snapshot replay", why)
+    elif cfg.redundancy > 0:
+        _log.warning("elastic: parity covers single-rank loss only "
+                     "(dead ranks %s); falling back to snapshot replay",
+                     dead or "unknown")
+    step = latest_snapshot_step(snap_dir)
+    if step is not None:
+        return {"mode": "replay", "package": None,
+                "resume": os.path.join(snap_dir, str(step)),
+                "step": step, "why": None}
+    if orig_ckpt:
+        return {"mode": "replay", "package": None, "resume": orig_ckpt,
+                "step": None, "why": None}
+    return {"mode": "scratch", "package": None, "resume": None,
+            "step": None, "why": None}
+
+
 def run_elastic_fit(plugin, trainer, module, datamodule,
                     ckpt_path: Optional[str]):
-    """Drive ``plugin._run_attempt`` with shrink-and-continue retries.
+    """Drive ``plugin._run_attempt`` with two-tier recovery retries.
 
     Returns the (eventually) successful attempt's result; sets
-    ``trainer._elastic_report`` with the restart history.
+    ``trainer._elastic_report`` with the restart history and the
+    recovery route taken.
     """
     cfg = trainer.elastic
     snap_dir = cfg.resolve_dir(trainer.default_root_dir)
     initial = plugin.num_workers
+    orig_ckpt = ckpt_path
+    trainer._elastic_recovery = None   # never inherit a stale package
     restarts = 0
+    decision_s = None
     report = {"initial_workers": initial, "workers": initial,
               "restarts": 0, "resumed_step": None}
     while True:
@@ -117,39 +171,60 @@ def run_elastic_fit(plugin, trainer, module, datamodule,
                                          "fit", ckpt_path)
         except BaseException as err:   # noqa: BLE001 - classified below
             dead = list(getattr(plugin, "_last_dead_ranks", ()) or ())
-            _dump_flights(plugin, err, dead)
             if not _restartable(err, dead):
+                _dump_flights(plugin, err, dead)
                 raise
             restarts += 1
             shrink = max(1, len(dead))
+            if dead and len(dead) >= plugin.num_workers:
+                # full-fleet loss: when the COORDINATOR rank dies, the
+                # survivors' jax.distributed clients abort with it —
+                # one preemption, N-1 collateral deaths.  Count one and
+                # keep going (the restart budget still bounds repeats);
+                # parity cannot help here (no survivor escrowed), so
+                # the route below falls to replay.
+                _log.warning(
+                    "elastic: full-fleet loss (%d/%d ranks dead — a "
+                    "coordinator death takes the survivors with it); "
+                    "counting one preemption and shrinking by 1",
+                    len(dead), plugin.num_workers)
+                shrink = 1
             new_workers = plugin.num_workers - shrink
             if restarts > cfg.max_restarts:
+                _dump_flights(plugin, err, dead)
                 _log.error(
                     "elastic: restart budget exhausted (%d); raising",
                     cfg.max_restarts)
                 raise
             if new_workers < cfg.min_workers:
+                _dump_flights(plugin, err, dead)
                 _log.error(
                     "elastic: shrinking %d -> %d would go below "
                     "min_workers=%d; raising", plugin.num_workers,
                     new_workers, cfg.min_workers)
                 raise
-            step = latest_snapshot_step(snap_dir)
-            if step is not None:
-                resume = os.path.join(snap_dir, str(step))
-            else:
-                resume = ckpt_path
+            t0 = time.monotonic()
+            route = _route_recovery(plugin, trainer, cfg, dead,
+                                    snap_dir, orig_ckpt)
+            decision_s = time.monotonic() - t0
+            _dump_flights(plugin, err, dead, recovery=route["mode"])
+            trainer._elastic_recovery = route["package"]
+            plugin._elastic_recovery_mode = route["mode"]
+            plugin._elastic_recovery_seconds = decision_s
+            resume = route["resume"]
+            if route["mode"] == "scratch":
                 _log.warning(
-                    "elastic: no durable snapshot under %s; restarting "
-                    "from %s", snap_dir,
-                    resume or "scratch (step 0)")
+                    "elastic: no durable snapshot under %s and no "
+                    "parity escrow; restarting from scratch (step 0)",
+                    snap_dir)
             _log.warning(
                 "elastic: worker failure (%s: %s); dead ranks %s — "
-                "shrinking %d -> %d workers (restart %d/%d) and "
-                "resuming from %s",
+                "shrinking %d -> %d workers (restart %d/%d), recovery "
+                "via %s from step %s",
                 type(err).__name__, str(err).splitlines()[0][:200],
                 dead or "unknown", plugin.num_workers, new_workers,
-                restarts, cfg.max_restarts, resume or "scratch")
+                restarts, cfg.max_restarts, route["mode"],
+                route["step"] if route["step"] is not None else "0")
             plugin.num_workers = new_workers
             # drop stale queue traffic from the dead fleet so a relayed
             # callable from attempt k never executes during attempt k+1
@@ -160,14 +235,34 @@ def run_elastic_fit(plugin, trainer, module, datamodule,
             ckpt_path = resume
             report = {"initial_workers": initial,
                       "workers": new_workers, "restarts": restarts,
-                      "resumed_step": step, "resumed_from": resume}
+                      "resumed_step": route["step"],
+                      "resumed_from": resume,
+                      "recovery": route["mode"],
+                      "recovery_decision_seconds": decision_s}
+            if route["package"] is not None:
+                # the dead fleet's parity counters rode the escrow —
+                # its workers never returned a result package
+                report.update(route["package"].get("escrow_stats", {}))
+                report["reconstruct_seconds"] = \
+                    route["package"].get("reconstruct_seconds")
             continue
+        # the recovery package is one-shot: a completed attempt consumed
+        # it (or never needed it) — a later fit must not resurrect it
+        trainer._elastic_recovery = None
         report.update(getattr(trainer, "_elastic_worker_stats", None)
                       or {})
+        if restarts:
+            # time-to-recover: driver-side route decision + the resumed
+            # attempt's time-to-first-step (rendezvous, recompile,
+            # restore — everything between death and training again)
+            ttfs = getattr(trainer, "time_to_first_step", None)
+            if decision_s is not None and ttfs is not None:
+                report["recovery_seconds"] = decision_s + ttfs
         trainer._elastic_report = report
         if restarts:
             _log.info("elastic: fit completed after %d restart(s) on "
-                      "%d/%d workers (resumed from step %s)", restarts,
-                      report["workers"], initial,
+                      "%d/%d workers (recovery=%s, resumed step %s)",
+                      restarts, report["workers"], initial,
+                      report.get("recovery"),
                       report.get("resumed_step"))
         return result

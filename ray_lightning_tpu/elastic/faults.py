@@ -1,4 +1,4 @@
-"""Deterministic fault injection for the elastic plane.
+"""Deterministic fault injection for the elastic plane (tier 2).
 
 Chaos testing a fault-tolerance subsystem needs *reproducible* faults:
 "kill rank 1 at step 5" must mean exactly that, every run, so the
@@ -12,14 +12,34 @@ what happens after.  A :class:`FaultSpec` names one fault:
   heartbeat watchdog can name it);
 - ``slow:rank=K,step=S[,seconds=T]`` — the rank stalls ``T`` seconds
   on every step from ``S`` on (a straggler, visible as skew in the
-  telemetry summary).
+  telemetry summary);
+- ``snapkill:rank=K,step=S[,code=C]`` — hard exit *mid-async-snapshot
+  write*: fires inside ``Snapshotter.maybe_snapshot`` right after the
+  orbax save is dispatched, so the step directory exists but never
+  commits — the case the "durable = committed only" resume contract
+  (elastic/driver.py ``latest_snapshot_step``) must absorb;
+- ``peerdrop:rank=K,step=S[,count=N]`` — drop the next N inbound
+  peer-channel frames on the rank (cluster/worker_state.py) — the
+  lossy-fabric case the peer retry/backoff and the parity tick's
+  skip-and-continue must absorb.
 
-:class:`FaultInjector` is a Callback armed with one spec; workers
+``RLT_FAULT`` accepts a semicolon-separated *list* of specs
+(``kill:rank=1,step=5;kill:rank=2,step=9``) so a chaos matrix —
+double-kill, kill-after-drop — is one env var.  Parse errors name the
+offending clause.  Every spec also takes ``restart=R``: arm only
+during elastic attempt R — a replayed segment re-crosses the fault
+step, so ``restart=0`` is how "exactly one preemption" stays
+expressible when recovery rewinds past the kill.
+
+:class:`FaultInjector` is a Callback armed with the spec list; workers
 auto-install it when ``RLT_FAULT`` is set in their environment
-(``Trainer._run_stage``), so a test arms a fault with
+(``Trainer._run_stage``), so a test arms faults with
 ``cpu_plugin(2, worker_env={"RLT_FAULT": "kill:rank=1,step=5"})`` and
-nothing else.  kill/wedge take the whole process down — only arm them
-on actor workers (a local in-process fit would kill the driver).
+nothing else.  kill/wedge/snapkill take the whole process down — only
+arm them on actor workers (a local in-process fit would kill the
+driver).  ``snapkill`` fires from the snapshot path, not the callback:
+the snapshotter consults :func:`maybe_snapkill` while its async save
+is in flight.
 """
 
 from __future__ import annotations
@@ -28,7 +48,7 @@ import dataclasses
 import logging
 import os
 import time
-from typing import Optional
+from typing import List, Optional
 
 from ray_lightning_tpu.core.callbacks import Callback
 
@@ -36,7 +56,7 @@ _log = logging.getLogger(__name__)
 
 ENV_FAULT = "RLT_FAULT"
 
-VALID_KINDS = ("kill", "wedge", "slow")
+VALID_KINDS = ("kill", "wedge", "slow", "snapkill", "peerdrop")
 
 #: distinctive default exit code so a driver log line can tell an
 #: injected kill from a real crash
@@ -52,6 +72,11 @@ class FaultSpec:
     step: int
     exit_code: int = DEFAULT_EXIT_CODE
     seconds: float = 1.0
+    count: int = 1
+    #: arm only on this elastic restart (None = every attempt).  A
+    #: replayed segment re-crosses the fault step; ``restart=0`` makes
+    #: "one preemption" expressible in a deterministic harness.
+    restart: Optional[int] = None
 
     def __post_init__(self):
         if self.kind not in VALID_KINDS:
@@ -64,18 +89,28 @@ class FaultSpec:
                              "counted post-increment)")
         if self.seconds <= 0:
             raise ValueError("fault seconds must be positive")
+        if self.count < 1:
+            raise ValueError("fault count must be >= 1")
 
-    def should_fire(self, rank: int, step: int) -> bool:
-        """kill/wedge fire once at the first step >= ``step`` on the
-        target rank; slow fires on every such step."""
+    def should_fire(self, rank: int, step: int,
+                    restarts: int = 0) -> bool:
+        """kill/wedge/snapkill/peerdrop fire once at the first step >=
+        ``step`` on the target rank; slow fires on every such step.
+        With ``restart=R`` set, only during elastic attempt R."""
+        if self.restart is not None and restarts != self.restart:
+            return False
         return rank == self.rank and step >= self.step
 
     def describe(self) -> str:
         extra = ""
-        if self.kind == "kill":
+        if self.kind in ("kill", "snapkill"):
             extra = f",code={self.exit_code}"
         elif self.kind == "slow":
             extra = f",seconds={self.seconds}"
+        elif self.kind == "peerdrop":
+            extra = f",count={self.count}"
+        if self.restart is not None:
+            extra += f",restart={self.restart}"
         return f"{self.kind}:rank={self.rank},step={self.step}{extra}"
 
 
@@ -97,7 +132,8 @@ def parse_fault(spec: str) -> FaultSpec:
             raise ValueError(f"fault spec field {part!r} is not key=value")
         key, _, val = part.partition("=")
         key = key.strip()
-        if key in ("rank", "step", "code", "exit_code"):
+        if key in ("rank", "step", "code", "exit_code", "count",
+                   "restart"):
             kw["exit_code" if key == "code" else key] = int(val)
         elif key == "seconds":
             kw["seconds"] = float(val)
@@ -108,42 +144,101 @@ def parse_fault(spec: str) -> FaultSpec:
     return FaultSpec(kind=kind.strip(), **kw)
 
 
+def parse_faults(raw: str) -> List[FaultSpec]:
+    """Semicolon-separated fault list → specs; a bad clause raises
+    naming ITSELF, not the whole string (the chaos matrix's parse
+    contract)."""
+    specs = []
+    for clause in raw.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        try:
+            specs.append(parse_fault(clause))
+        except ValueError as e:
+            raise ValueError(
+                f"bad fault clause {clause!r} in {ENV_FAULT}: {e}"
+            ) from e
+    if not specs:
+        raise ValueError(f"{ENV_FAULT} is set but names no fault")
+    return specs
+
+
+def _die(spec: FaultSpec, step: int, where: str) -> None:
+    _log.warning("fault injector: killing rank %d at step %d %s "
+                 "(exit %d)", spec.rank, step, where, spec.exit_code)
+    # flush the log line before the no-cleanup exit
+    logging.shutdown()
+    os._exit(spec.exit_code)
+
+
 class FaultInjector(Callback):
-    """Callback arming one :class:`FaultSpec` against the live run."""
+    """Callback arming one or more :class:`FaultSpec` against the run."""
 
     needs_batch = False   # fires on (rank, step) alone
 
-    def __init__(self, spec: FaultSpec):
-        self.spec = spec
-        self._fired = False
+    def __init__(self, specs):
+        if isinstance(specs, FaultSpec):
+            specs = [specs]
+        self.specs = list(specs)
+        self._fired: set = set()
+
+    @property
+    def spec(self) -> FaultSpec:
+        """First spec (back-compat for single-fault callers)."""
+        return self.specs[0]
 
     def on_train_batch_end(self, trainer, module, outputs, batch,
                            batch_idx) -> None:
-        spec = self.spec
-        if not spec.should_fire(trainer.global_rank, trainer.global_step):
-            return
-        if spec.kind == "slow":
-            _log.warning("fault injector: slowing rank %d at step %d "
-                         "for %.2fs", spec.rank, trainer.global_step,
-                         spec.seconds)
-            time.sleep(spec.seconds)
-            return
-        if self._fired:
-            return
-        self._fired = True
-        if spec.kind == "kill":
-            _log.warning("fault injector: killing rank %d at step %d "
-                         "(exit %d)", spec.rank, trainer.global_step,
-                         spec.exit_code)
-            # flush the log line before the no-cleanup exit
-            logging.shutdown()
-            os._exit(spec.exit_code)
-        # wedge: stop making progress without dying — the connection
-        # stays open, so only the heartbeat watchdog can diagnose it
-        _log.warning("fault injector: wedging rank %d at step %d",
-                     spec.rank, trainer.global_step)
-        while True:
-            time.sleep(3600)
+        rank, step = trainer.global_rank, trainer.global_step
+        restarts = _elastic_restarts(trainer)
+        for i, spec in enumerate(self.specs):
+            if spec.kind == "snapkill" \
+                    or not spec.should_fire(rank, step, restarts):
+                continue   # snapkill fires from the snapshot path
+            if spec.kind == "slow":
+                _log.warning("fault injector: slowing rank %d at step %d "
+                             "for %.2fs", spec.rank, step, spec.seconds)
+                time.sleep(spec.seconds)
+                continue
+            if i in self._fired:
+                continue
+            self._fired.add(i)
+            if spec.kind == "kill":
+                _die(spec, step, "")
+            elif spec.kind == "peerdrop":
+                from ray_lightning_tpu.cluster import worker_state
+                _log.warning(
+                    "fault injector: dropping the next %d inbound peer "
+                    "frames on rank %d (step %d)", spec.count, spec.rank,
+                    step)
+                worker_state.arm_peer_drop(spec.count)
+            else:
+                # wedge: stop making progress without dying — the
+                # connection stays open, so only the heartbeat watchdog
+                # can diagnose it
+                _log.warning("fault injector: wedging rank %d at step %d",
+                             spec.rank, step)
+                while True:
+                    time.sleep(3600)
+
+
+def _elastic_restarts(trainer) -> int:
+    return (getattr(trainer, "_elastic_state", None) or {}).get(
+        "restarts", 0)
+
+
+def maybe_snapkill(rank: int, step: int, restarts: int = 0) -> None:
+    """Snapshot-path hook (elastic/snapshot.py): hard-exit NOW if an
+    armed ``snapkill`` spec matches — called while the async orbax
+    write is in flight, so the save never commits."""
+    raw = os.environ.get(ENV_FAULT, "").strip()
+    if not raw or "snapkill" not in raw:
+        return
+    for spec in parse_faults(raw):
+        if spec.kind == "snapkill" \
+                and spec.should_fire(rank, step, restarts):
+            _die(spec, step, "mid-async-snapshot write")
 
 
 def maybe_injector_from_env() -> Optional[FaultInjector]:
@@ -153,4 +248,4 @@ def maybe_injector_from_env() -> Optional[FaultInjector]:
     raw = os.environ.get(ENV_FAULT, "").strip()
     if not raw:
         return None
-    return FaultInjector(parse_fault(raw))
+    return FaultInjector(parse_faults(raw))

@@ -5,11 +5,17 @@ deterministic, no pytest — validates the invariants that would
 otherwise only fail deep inside a shrinking fleet:
 
 1. ``ElasticConfig`` validation + ``RLT_ELASTIC*`` env round-trip
-   (``worker_env`` → ``resolve`` reproduces the config);
-2. fault-spec parsing (every kind round-trips; malformed specs raise);
+   (``worker_env`` → ``resolve`` reproduces the config, redundancy
+   knobs included);
+2. fault-spec parsing (every kind round-trips; semicolon lists parse;
+   malformed specs raise naming the bad clause);
 3. every elastic metric name is Prometheus-clean (the PR 2 lint);
 4. the residual re-bucket preserves the injected-error invariant
-   ``(1/M)·Σ new = (1/N)·Σ old`` on a small CPU array.
+   ``(1/M)·Σ new = (1/N)·Σ old`` on a small CPU array;
+5. parity invariants (elastic/redundancy.py): XOR
+   encode→drop-one→decode round-trips BIT-EXACT for every dead-rank
+   position at several (world, k), and the holder/coverage geometry is
+   consistent (every rank's blob is covered by exactly k holders).
 """
 
 from __future__ import annotations
@@ -22,7 +28,9 @@ def _check_config() -> None:
     cfg = ElasticConfig(enabled=True, snapshot_every_n_steps=25,
                         snapshot_dir="/tmp/ck", max_restarts=3,
                         min_workers=2, preserve_global_batch=False,
-                        max_to_keep=5)
+                        max_to_keep=5, redundancy=2,
+                        redundancy_every_n_steps=4,
+                        max_snapshot_failures=7)
     saved = {k: os.environ.get(k) for k in list(os.environ)
              if k.startswith("RLT_ELASTIC")}
     try:
@@ -38,7 +46,9 @@ def _check_config() -> None:
     assert not ElasticConfig.resolve(None).enabled
     assert ElasticConfig.resolve({"snapshot_every_n_steps": 5}).enabled
     for bad in (dict(snapshot_every_n_steps=-1), dict(min_workers=0),
-                dict(max_restarts=-1), dict(max_to_keep=0)):
+                dict(max_restarts=-1), dict(max_to_keep=0),
+                dict(redundancy=-1), dict(redundancy_every_n_steps=0),
+                dict(max_snapshot_failures=0)):
         try:
             ElasticConfig(enabled=True, **bad)
         except ValueError:
@@ -49,7 +59,8 @@ def _check_config() -> None:
 
 
 def _check_faults() -> None:
-    from ray_lightning_tpu.elastic.faults import FaultSpec, parse_fault
+    from ray_lightning_tpu.elastic.faults import (FaultSpec, parse_fault,
+                                                  parse_faults)
 
     s = parse_fault("kill:rank=1,step=5,code=9")
     assert s == FaultSpec("kill", 1, 5, exit_code=9)
@@ -59,9 +70,26 @@ def _check_faults() -> None:
     slow = parse_fault("slow:rank=2,step=3,seconds=0.5")
     assert slow.seconds == 0.5
     assert parse_fault(s.describe()) == s   # describe round-trips
+    snap = parse_fault("snapkill:rank=1,step=4")
+    assert snap.kind == "snapkill" and parse_fault(snap.describe()) == snap
+    drop = parse_fault("peerdrop:rank=0,step=3,count=2")
+    assert drop.count == 2 and parse_fault(drop.describe()) == drop
+    once = parse_fault("kill:rank=0,step=5,restart=0")
+    assert once.restart == 0 and parse_fault(once.describe()) == once
+    assert once.should_fire(0, 5, restarts=0)
+    assert not once.should_fire(0, 5, restarts=1)   # replayed segment
+    # semicolon lists (the chaos matrix's double-kill shape)
+    specs = parse_faults("kill:rank=1,step=5; kill:rank=2,step=5")
+    assert [x.rank for x in specs] == [1, 2]
+    try:
+        parse_faults("kill:rank=1,step=5;boom:rank=2,step=5")
+    except ValueError as e:
+        assert "boom:rank=2,step=5" in str(e), e   # names the bad clause
+    else:
+        raise AssertionError("bad clause in a list did not raise")
     for bad in ("kill", "boom:rank=1,step=2", "kill:rank=1",
                 "kill:rank=1,step=0", "kill:rank=-1,step=2",
-                "kill:rank=1;step=2"):
+                "kill:rank=1;step=2", "peerdrop:rank=0,step=1,count=0"):
         try:
             parse_fault(bad)
         except ValueError:
@@ -74,9 +102,15 @@ def _check_faults() -> None:
 def _check_metric_names() -> None:
     from ray_lightning_tpu.telemetry.metrics import validate_metric_name
     for name in ("rlt_snapshot_total", "rlt_snapshot_skipped_total",
+                 "rlt_snapshot_failed_total",
                  "rlt_snapshot_seconds_total",
                  "rlt_snapshot_stall_seconds_total",
-                 "rlt_restarts_total", "rlt_worker_alive"):
+                 "rlt_snapshot_restore_total",
+                 "rlt_restarts_total", "rlt_worker_alive",
+                 "rlt_parity_ticks_total", "rlt_parity_bytes_total",
+                 "rlt_parity_skipped_total", "rlt_parity_restore_total",
+                 "rlt_recovery_mode", "rlt_recovery_seconds",
+                 "rlt_peer_retries_total"):
         validate_metric_name(name)
     print("elastic selfcheck: metric names Prometheus-clean")
 
@@ -101,11 +135,44 @@ def _check_rebucket() -> None:
           "injected-error sum")
 
 
+def _check_parity() -> None:
+    import numpy as np
+    from ray_lightning_tpu.elastic.redundancy import (ParityGroup,
+                                                      recover_block,
+                                                      xor_blocks)
+
+    rng = np.random.default_rng(7)
+    for world, k in ((2, 1), (3, 1), (3, 2), (5, 2), (4, 3)):
+        # rank blobs of deliberately UNEQUAL lengths (zero-padding leg)
+        blobs = [rng.bytes(64 + 13 * r) for r in range(world)]
+        # geometry: every rank's blob held by exactly k parity holders
+        held_by: dict = {r: [] for r in range(world)}
+        for r in range(world):
+            g = ParityGroup(r, world, k)
+            assert g.holders == [(r - 1 - i) % world for i in range(g.k)]
+            for m in g.covers:
+                held_by[m].append(r)
+        kk = min(k, world - 1)
+        assert all(len(v) == kk for v in held_by.values()), held_by
+        # encode → drop any one rank → decode, bit-exact
+        for dead in range(world):
+            holder = ParityGroup.holder_of(dead, world, k)
+            g = ParityGroup(holder, world, k)
+            assert dead in g.covers
+            parity = xor_blocks([blobs[m] for m in g.covers])
+            others = [blobs[m] for m in g.covers if m != dead]
+            got = recover_block(parity, others, len(blobs[dead]))
+            assert got == blobs[dead], (world, k, dead)
+    print("elastic selfcheck: XOR parity encode→drop-one→decode "
+          "bit-exact for every rank position")
+
+
 def _main(argv: list) -> int:
     _check_config()
     _check_faults()
     _check_metric_names()
     _check_rebucket()
+    _check_parity()
     return 0
 
 

@@ -21,13 +21,24 @@ outstanding, never an unbounded queue:
   into ``rlt_snapshot_stall_seconds_total`` (the number the bench
   reports; near zero when the cadence out-paces the write).
 
+**Failure hardening**: a failed async save must not kill training — a
+flaky snapshot target (full disk, GCS blip) costs durability headroom,
+not the run.  A save that raises is caught, counted
+(``rlt_snapshot_failed_total``), and retried at the next cadence tick;
+only ``ElasticConfig.max_snapshot_failures`` CONSECUTIVE failures
+re-raise (a permanently broken target must not fail silently — the
+elastic driver would otherwise keep "recovering" onto snapshots that
+stopped landing).  The ``snapkill`` chaos fault (elastic/faults.py)
+fires here, mid-async-write, so the uncommitted-step resume contract
+is testable.
+
 Instruments (metrics plane, PR 2): ``rlt_snapshot_total``,
-``rlt_snapshot_skipped_total``, ``rlt_snapshot_seconds_total``
-(blocking host time of the save call), and
-``rlt_snapshot_stall_seconds_total``.  The same numbers accumulate in
-:attr:`Snapshotter.stats` so benches and tests read them without the
-metrics plane; the ``checkpoint`` span (utils/checkpoint.py) already
-covers each save's blocking section in the trace.
+``rlt_snapshot_skipped_total``, ``rlt_snapshot_failed_total``,
+``rlt_snapshot_seconds_total`` (blocking host time of the save call),
+and ``rlt_snapshot_stall_seconds_total``.  The same numbers accumulate
+in :attr:`Snapshotter.stats` so benches and tests read them without
+the metrics plane; the ``checkpoint`` span (utils/checkpoint.py)
+already covers each save's blocking section in the trace.
 """
 
 from __future__ import annotations
@@ -52,9 +63,11 @@ class Snapshotter:
         self.stats = {
             "snapshots": 0,
             "skipped": 0,
+            "failed": 0,
             "save_seconds": 0.0,
             "stall_seconds": 0.0,
         }
+        self._consecutive_failures = 0
         import jax
         self._multiprocess = jax.process_count() > 1
 
@@ -93,11 +106,37 @@ class Snapshotter:
             _log.info("elastic snapshot at step %d stalled %.3fs behind "
                       "the previous save", t.global_step, stall)
         t0 = time.monotonic()
-        t.save_sharded_checkpoint(self.directory,
-                                  max_to_keep=self.cfg.max_to_keep)
+        try:
+            t.save_sharded_checkpoint(self.directory,
+                                      max_to_keep=self.cfg.max_to_keep)
+        except Exception:   # noqa: BLE001 - hardened: counted + retried
+            self._consecutive_failures += 1
+            self.stats["failed"] += 1
+            self._count("rlt_snapshot_failed_total")
+            limit = self.cfg.max_snapshot_failures
+            if self._consecutive_failures >= limit:
+                _log.error(
+                    "elastic snapshot at step %d failed %d consecutive "
+                    "times (limit %d); raising — the snapshot target is "
+                    "broken, not flaky", t.global_step,
+                    self._consecutive_failures, limit)
+                raise
+            _log.warning(
+                "elastic snapshot at step %d failed (%d consecutive, "
+                "limit %d); training continues, retrying next cadence "
+                "tick", t.global_step, self._consecutive_failures,
+                limit, exc_info=True)
+            return False
         dt = time.monotonic() - t0
+        self._consecutive_failures = 0
         self.stats["snapshots"] += 1
         self.stats["save_seconds"] += dt
         self._count("rlt_snapshot_total")
         self._count("rlt_snapshot_seconds_total", dt)
+        # chaos hook: an armed snapkill fires HERE, while the async
+        # orbax write is still in flight — the step dir never commits
+        from ray_lightning_tpu.elastic.faults import (_elastic_restarts,
+                                                      maybe_snapkill)
+        maybe_snapkill(t.global_rank, t.global_step,
+                       _elastic_restarts(t))
         return True

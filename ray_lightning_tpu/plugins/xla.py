@@ -239,6 +239,9 @@ class RayXlaPlugin(ExecutionPlugin):
         state["init_hook"] = None  # already executed before shipping
         state.pop("_telemetry_agg", None)  # live driver-side aggregator
         state.pop("_metrics_server", None)  # live driver HTTP listener
+        # harvested escrow blobs are driver-side recovery state; only
+        # the assembled package (trainer._elastic_recovery) ships
+        state.pop("_last_escrows", None)
         return state
 
     def __setstate__(self, state):
@@ -358,6 +361,12 @@ class RayXlaPlugin(ExecutionPlugin):
         # lets a late/stale connection from a previous run race the new
         # worker's attach
         run_tag = uuid.uuid4().hex[:8]
+        worker_names = [f"rlt-worker-{os.getpid()}-{run_tag}-{i}"
+                        for i in range(self.num_workers)]
+        # rank-ordered actor names reach every worker so rank r can
+        # peer_send to rank s by name — the worker↔worker channel the
+        # elastic parity tick rides (elastic/redundancy.py)
+        base_env["RLT_PEER_NAMES"] = ",".join(worker_names)
         self._workers = [
             backend.create_actor(
                 RLTExecutor,
@@ -365,7 +374,12 @@ class RayXlaPlugin(ExecutionPlugin):
                 # it (set_env_vars re-sends the same value later)
                 env={**base_env, "RLT_PROCESS_ID": str(i)},
                 resources=self._worker_resources(),
-                name=f"rlt-worker-{os.getpid()}-{run_tag}-{i}",
+                name=worker_names[i],
+                # Ray: peer deliveries + escrow harvests are concurrent
+                # actor calls and must run beside a busy main call; the
+                # builtin backend serves both from its reader thread
+                # and ignores this
+                max_concurrency=2,
             )
             for i in range(self.num_workers)
         ]
@@ -380,8 +394,13 @@ class RayXlaPlugin(ExecutionPlugin):
                 hard_timeout=cfg.hard_timeout,
                 flight_capacity=cfg.flight_capacity)
             # elastic restart count survives the per-attempt aggregator
-            # rebuild so /metrics' rlt_restarts_total is cumulative
+            # rebuild so /metrics' rlt_restarts_total is cumulative,
+            # and the recovery route the driver chose for THIS attempt
+            # (parity vs replay) is a scrapeable series
             agg.set_restarts(getattr(self, "_elastic_restarts", 0))
+            agg.set_recovery(getattr(self, "_elastic_recovery_mode", None),
+                             getattr(self, "_elastic_recovery_seconds",
+                                     None))
             for i, w in enumerate(self._workers):
                 agg.register_worker(i, w)
             telemetry.set_active(agg)
@@ -414,6 +433,24 @@ class RayXlaPlugin(ExecutionPlugin):
             self._last_dead_ranks = [
                 i for i, w in enumerate(self._workers)
                 if w.process_alive() is False]
+            # harvest survivor escrows BEFORE the finally below kills
+            # them: the parity-tick state deposited on each survivor
+            # (elastic/redundancy.py) is what reconstruct-and-continue
+            # recovers from, served by the workers' reader threads even
+            # when their main threads are wedged in a dead collective
+            self._last_escrows = {}
+            elastic = getattr(trainer, "elastic", None)
+            if stage == "fit" and elastic is not None \
+                    and elastic.enabled and elastic.redundancy > 0:
+                for i, w in enumerate(self._workers):
+                    if i in self._last_dead_ranks:
+                        continue
+                    try:
+                        esc = w.harvest_escrow(timeout=15.0)
+                    except Exception:   # noqa: BLE001 - best-effort
+                        esc = None
+                    if esc is not None:
+                        self._last_escrows[i] = esc
             raise
         finally:
             if dc is not None:

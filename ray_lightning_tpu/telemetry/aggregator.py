@@ -122,6 +122,10 @@ class TelemetryAggregator:
         #: driver-log text (rlt_worker_alive / rlt_restarts_total)
         self._fleet_alive: dict[int, int] = {}
         self._restarts = 0
+        #: recovery route + driver-side decision seconds of the current
+        #: elastic attempt (parity | replay | scratch — elastic/driver)
+        self._recovery_mode: Optional[str] = None
+        self._recovery_seconds: Optional[float] = None
 
     # -- ingestion -------------------------------------------------------
 
@@ -183,6 +187,17 @@ class TelemetryAggregator:
         with self._lock:
             self._restarts = int(n)
 
+    def set_recovery(self, mode: Optional[str],
+                     seconds: Optional[float] = None) -> None:
+        """The recovery route the elastic driver chose for this attempt
+        (``parity``/``replay``/``scratch``) plus its classification+
+        reconstruction seconds — exported as ``rlt_recovery_mode`` /
+        ``rlt_recovery_seconds`` driver-side series so the zero-replay
+        path is visible on ``/metrics``, not just in the report."""
+        with self._lock:
+            self._recovery_mode = mode
+            self._recovery_seconds = seconds
+
     def note_worker_alive(self, rank: int, alive: bool) -> None:
         with self._lock:
             self._fleet_alive[rank] = 1 if alive else 0
@@ -208,13 +223,22 @@ class TelemetryAggregator:
         with self._lock:
             fleet = dict(self._fleet_alive)
             restarts = self._restarts
-        if not fleet and not restarts:
+            rec_mode = self._recovery_mode
+            rec_s = self._recovery_seconds
+        if not fleet and not restarts and rec_mode is None:
             return []
         out = [{"name": "rlt_worker_alive", "type": "gauge",
                 "labels": {"worker": str(rank)}, "value": v}
                for rank, v in sorted(fleet.items())]
         out.append({"name": "rlt_restarts_total", "type": "counter",
                     "labels": {}, "value": restarts})
+        if rec_mode is not None:
+            out.append({"name": "rlt_recovery_mode", "type": "gauge",
+                        "labels": {"mode": rec_mode}, "value": 1})
+            if rec_s is not None:
+                out.append({"name": "rlt_recovery_seconds",
+                            "type": "gauge", "labels": {},
+                            "value": rec_s})
         return out
 
     def fleet_health(self) -> dict[int, int]:

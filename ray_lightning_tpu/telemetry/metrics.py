@@ -55,7 +55,7 @@ UNIT_SUFFIXES = ("_bytes", "_seconds", "_total")
 #: unitless boolean gauges (Prometheus "up"-style) explicitly exempt
 #: from the unit-suffix rule — a 0/1 liveness verdict has no unit to
 #: carry.  Keep this list short and deliberate.
-UNITLESS_GAUGES = ("rlt_worker_alive",)
+UNITLESS_GAUGES = ("rlt_worker_alive", "rlt_recovery_mode")
 
 #: step-time histogram bounds (seconds): sub-ms dispatch latency up to
 #: multi-second giant-model steps
@@ -94,10 +94,23 @@ CORE_METRICS = (
     # health series the aggregator synthesizes)
     "rlt_snapshot_total",
     "rlt_snapshot_skipped_total",
+    "rlt_snapshot_failed_total",
     "rlt_snapshot_seconds_total",
     "rlt_snapshot_stall_seconds_total",
+    "rlt_snapshot_restore_total",
     "rlt_restarts_total",
     "rlt_worker_alive",
+    # zero-replay recovery (elastic/redundancy.py + driver routing):
+    # parity-tick wire bytes, skipped ticks, in-memory restores, the
+    # chosen route and its driver-side decision seconds
+    "rlt_parity_ticks_total",
+    "rlt_parity_bytes_total",
+    "rlt_parity_skipped_total",
+    "rlt_parity_restore_total",
+    "rlt_recovery_mode",
+    "rlt_recovery_seconds",
+    # peer-channel retry trail (cluster/peer.py bounded backoff)
+    "rlt_peer_retries_total",
     # MPMD plane (mpmd/engine.py): simulated bubble seconds/step per
     # schedule, set once per fit from the measured per-op replay
     "rlt_mpmd_bubble_seconds",

@@ -42,6 +42,43 @@ def test_dead_peer_timeout_names_waiter_and_payload():
     assert "'fwd'" in msg and "2" in msg   # what was missing, from whom
 
 
+def test_retry_backoff_rewaits_and_eventually_succeeds(monkeypatch):
+    """RLT_PEER_RETRIES re-waits with backoff: a payload that arrives
+    during the SECOND attempt is delivered instead of raising."""
+    import threading
+    import time
+
+    monkeypatch.setenv("RLT_PEER_RETRIES", "3")
+    monkeypatch.setenv("RLT_PEER_BACKOFF_S", "0.01")
+    box = Mailbox()
+
+    def late_put():
+        time.sleep(0.25)
+        box.put(("late",), "made it")
+
+    t = threading.Thread(target=late_put)
+    t.start()
+    try:
+        assert box.take(("late",), 0.1) == "made it"
+    finally:
+        t.join()
+
+
+def test_retry_budget_exhaustion_names_attempt_count(monkeypatch):
+    monkeypatch.setenv("RLT_PEER_RETRIES", "2")
+    monkeypatch.setenv("RLT_PEER_BACKOFF_S", "0.01")
+    box = Mailbox()
+    with pytest.raises(PeerTimeout, match="3 attempt"):
+        box.take(("never",), 0.02, who="retry waiter")
+
+
+def test_default_policy_is_single_attempt(monkeypatch):
+    monkeypatch.delenv("RLT_PEER_RETRIES", raising=False)
+    box = Mailbox()
+    with pytest.raises(PeerTimeout, match="1 attempt"):
+        box.take(("never",), 0.02)
+
+
 class _PeerActor:
     """Minimal peer-channel participant: blocks inside a call waiting
     for a payload (proving delivery does not need the main thread),
@@ -49,6 +86,16 @@ class _PeerActor:
 
     def ping(self):
         return "pong"
+
+    def deposit_escrow(self, item):
+        from ray_lightning_tpu.cluster import worker_state
+        worker_state.escrow_set(item)
+        return True
+
+    def block_forever(self):
+        import time
+        while True:
+            time.sleep(3600)
 
     def wait_for(self, tag, timeout):
         from ray_lightning_tpu.cluster import worker_state
@@ -96,5 +143,27 @@ def test_local_backend_routes_peer_frames_mid_call():
         with pytest.raises(Exception, match="receiver actor"):
             a.call("wait_for", ("never", 0, 0, 0), 0.2).result(
                 timeout=60)
+    finally:
+        backend.shutdown()
+
+
+def test_escrow_harvest_bypasses_a_wedged_main_thread():
+    """The zero-replay prerequisite (elastic/redundancy.py): the
+    driver can fetch a worker's recovery escrow WHILE its main thread
+    is stuck — the frame-reader thread answers ``escrow`` frames
+    directly.  A worker that never escrowed answers None."""
+    from ray_lightning_tpu.cluster.local import LocalBackend
+
+    backend = LocalBackend()
+    try:
+        a = backend.create_actor(_PeerActor, name="escrow-a")
+        assert a.call("ping").result(timeout=60) == "pong"
+        assert a.harvest_escrow(timeout=20) is None   # nothing yet
+        assert a.call("deposit_escrow",
+                      {"step": 7, "rank": 0}).result(timeout=60)
+        # wedge the main thread, then harvest around it
+        a.call("block_forever")
+        esc = a.harvest_escrow(timeout=20)
+        assert esc == {"step": 7, "rank": 0}
     finally:
         backend.shutdown()

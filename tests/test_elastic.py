@@ -36,11 +36,15 @@ def test_elastic_config_resolve_env(monkeypatch):
     monkeypatch.setenv("RLT_ELASTIC_MIN_WORKERS", "2")
     monkeypatch.setenv("RLT_ELASTIC_KEEP", "7")
     monkeypatch.setenv("RLT_ELASTIC_PRESERVE_BATCH", "0")
+    monkeypatch.setenv("RLT_ELASTIC_REDUNDANCY", "2")
+    monkeypatch.setenv("RLT_ELASTIC_REDUNDANCY_EVERY", "4")
+    monkeypatch.setenv("RLT_ELASTIC_SNAPSHOT_FAILURES", "9")
     cfg = ElasticConfig.resolve(None)
     assert cfg == ElasticConfig(
         enabled=True, snapshot_every_n_steps=25, snapshot_dir="/tmp/snaps",
         max_restarts=5, min_workers=2, preserve_global_batch=False,
-        max_to_keep=7)
+        max_to_keep=7, redundancy=2, redundancy_every_n_steps=4,
+        max_snapshot_failures=9)
     # worker_env -> resolve round-trips (the RLT_COMM* contract)
     for k in list(os.environ):
         if k.startswith("RLT_ELASTIC"):
@@ -94,6 +98,45 @@ def test_slow_fault_injects_stall(tmp_path, seed):
         os.environ.pop("RLT_FAULT", None)
     assert trainer.global_step == 3
     assert time.monotonic() - t0 >= 0.4   # steps 2 and 3 each stalled
+
+
+def test_fault_list_and_new_kinds():
+    """Tier-2 harness: semicolon lists, snapkill, peerdrop — and parse
+    errors that name the bad clause."""
+    from ray_lightning_tpu.elastic.faults import parse_faults
+
+    specs = parse_faults("kill:rank=1,step=5 ; kill:rank=2,step=9")
+    assert [(s.rank, s.step) for s in specs] == [(1, 5), (2, 9)]
+    snap = parse_fault("snapkill:rank=1,step=4,code=7")
+    assert snap.kind == "snapkill" and snap.exit_code == 7
+    assert parse_fault(snap.describe()) == snap
+    drop = parse_fault("peerdrop:rank=0,step=3,count=5")
+    assert drop.count == 5
+    assert parse_fault(drop.describe()) == drop
+    with pytest.raises(ValueError, match="boom:rank=2,step=1"):
+        parse_faults("kill:rank=1,step=5;boom:rank=2,step=1")
+    with pytest.raises(ValueError, match="names no fault"):
+        parse_faults(" ; ")
+    with pytest.raises(ValueError):
+        parse_fault("peerdrop:rank=0,step=1,count=0")
+
+
+def test_peerdrop_swallows_inbound_frames():
+    from ray_lightning_tpu.cluster import worker_state
+
+    worker_state.reset_for_tests()
+    try:
+        worker_state.arm_peer_drop(2)
+        box = worker_state.peer_mailbox()
+        for i in range(3):
+            worker_state.peer_push({"tag": ("t", i), "wire": i})
+        # first two dropped, third delivered
+        assert worker_state.peer_drop_pending() == 0
+        assert box.take(("t", 2), 0.2) == 2
+        with pytest.raises(Exception):
+            box.take(("t", 0), 0.05)
+    finally:
+        worker_state.reset_for_tests()
 
 
 # -- snapshotting ---------------------------------------------------------
@@ -267,6 +310,221 @@ def test_reshard_rejects_incompatible_shapes(tmp_path, seed):
                  resume_from_checkpoint=ck)
     with pytest.raises(Exception, match="kernel"):
         t2.fit(WiderBoring())
+
+
+# -- async-snapshot failure hardening -------------------------------------
+
+def test_snapshot_failure_is_absorbed_and_counted(tmp_path, seed,
+                                                  monkeypatch):
+    """A flaky async save must not kill training: caught, counted,
+    retried next tick — and a later success resets the consecutive
+    counter."""
+    from ray_lightning_tpu.core.trainer import Trainer as _T
+
+    calls = {"n": 0}
+    real = _T.save_sharded_checkpoint
+
+    def flaky(self, directory, step=None, max_to_keep=None):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("disk full (injected)")
+        return real(self, directory, step=step, max_to_keep=max_to_keep)
+
+    monkeypatch.setattr(_T, "save_sharded_checkpoint", flaky)
+    snap = str(tmp_path / "elastic")
+    trainer = Trainer(
+        max_epochs=10, max_steps=4, enable_checkpointing=False,
+        num_sanity_val_steps=0, limit_val_batches=0, seed=0,
+        log_every_n_steps=1, default_root_dir=str(tmp_path),
+        elastic={"snapshot_every_n_steps": 1, "snapshot_dir": snap,
+                 "max_snapshot_failures": 3})
+    trainer.fit(BoringModel())
+    assert trainer.global_step == 4            # training survived
+    stats = trainer.elastic_stats()
+    assert stats["failed"] == 2
+    # steps 3/4: saved, or skipped behind step 3's still-writing save
+    # (bounded backpressure) — either way the failure streak reset
+    assert stats["snapshots"] >= 1
+    assert stats["snapshots"] + stats["skipped"] == 2
+
+
+def test_snapshot_consecutive_failures_eventually_raise(tmp_path, seed,
+                                                        monkeypatch):
+    """A permanently broken snapshot target must not fail silently."""
+    from ray_lightning_tpu.core.trainer import Trainer as _T
+
+    monkeypatch.setattr(
+        _T, "save_sharded_checkpoint",
+        lambda self, directory, step=None, max_to_keep=None:
+        (_ for _ in ()).throw(OSError("target gone (injected)")))
+    trainer = Trainer(
+        max_epochs=10, max_steps=8, enable_checkpointing=False,
+        num_sanity_val_steps=0, limit_val_batches=0, seed=0,
+        log_every_n_steps=1, default_root_dir=str(tmp_path),
+        elastic={"snapshot_every_n_steps": 1,
+                 "snapshot_dir": str(tmp_path / "elastic"),
+                 "max_snapshot_failures": 2})
+    with pytest.raises(OSError, match="target gone"):
+        trainer.fit(BoringModel())
+    assert trainer.elastic_stats()["failed"] == 2
+
+
+# -- parity redundancy (elastic/redundancy.py) ----------------------------
+
+def test_parity_xor_roundtrip_every_position():
+    from ray_lightning_tpu.elastic.redundancy import (ParityGroup,
+                                                      recover_block,
+                                                      xor_blocks)
+    rng = np.random.default_rng(3)
+    for world, k in ((2, 1), (3, 1), (4, 2)):
+        blobs = [rng.bytes(50 + 11 * r) for r in range(world)]
+        for dead in range(world):
+            holder = ParityGroup.holder_of(dead, world, k)
+            g = ParityGroup(holder, world, k)
+            assert dead in g.covers
+            parity = xor_blocks([blobs[m] for m in g.covers])
+            others = [blobs[m] for m in g.covers if m != dead]
+            assert recover_block(parity, others,
+                                 len(blobs[dead])) == blobs[dead]
+
+
+def test_pack_partition_splits_unique_and_replicated():
+    """Sharded leaves (the ZeRO-1 optimizer partition) land in the
+    unique blob with their global indices; replicated leaves in the
+    replicated blob — and both assemble back bit-exact."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ray_lightning_tpu.elastic.redundancy import (assemble_leaf,
+                                                      pack_partition,
+                                                      unpack_partition)
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("data",))
+    opt = jax.device_put(np.arange(8, dtype=np.float32).reshape(4, 2),
+                         NamedSharding(mesh, P("data")))
+    par = jax.device_put(np.ones((3,), np.float32),
+                         NamedSharding(mesh, P()))
+    state = {"opt": opt, "params": par}
+    uu = unpack_partition(pack_partition(state, unique=True))
+    rr = unpack_partition(pack_partition(state, unique=False))
+    assert set(uu) == {"opt"} and set(rr) == {"params"}
+    np.testing.assert_array_equal(
+        assemble_leaf(uu["opt"]),
+        np.arange(8, dtype=np.float32).reshape(4, 2))
+    np.testing.assert_array_equal(assemble_leaf(rr["params"]),
+                                  np.ones((3,), np.float32))
+    # a gap in the pieces must raise, not silently zero-fill
+    broken = dict(uu["opt"])
+    broken["pieces"] = uu["opt"]["pieces"][:1]
+    with pytest.raises(ValueError, match="cover"):
+        assemble_leaf(broken)
+
+
+class _FakeTrainer:
+    def __init__(self, step):
+        self.global_step = step
+        self.current_epoch = 0
+        self.callbacks = []
+        self.lightning_module = None
+        self.state = None
+
+
+def _fake_manager(rank, world, blobs, reps, boxes, escrows, every=1):
+    import cloudpickle
+    from ray_lightning_tpu.elastic.config import ElasticConfig
+    from ray_lightning_tpu.elastic.redundancy import (
+        LoopbackParityTransport, RedundancyManager)
+
+    cfg = ElasticConfig(enabled=True, redundancy=1,
+                        redundancy_every_n_steps=every)
+    mgr = RedundancyManager(
+        _FakeTrainer(step=2), cfg, rank, world,
+        LoopbackParityTransport(boxes, rank),
+        store=lambda e, _r=rank: escrows.__setitem__(_r, e))
+    mgr._pack = lambda unique, _r=rank: cloudpickle.dumps(
+        blobs[_r] if unique else reps[_r])
+    return mgr
+
+
+def test_redundancy_manager_tick_and_driver_reconstruction():
+    """Two simulated ranks tick over a loopback channel; killing either
+    one, the driver-side reconstruction rebuilds its partition
+    bit-exact and assembles a full-coverage package."""
+    import threading
+    from ray_lightning_tpu.cluster.peer import Mailbox
+    from ray_lightning_tpu.elastic.redundancy import (assemble_leaf,
+                                                      build_recovery)
+
+    full = np.arange(8, dtype=np.float32).reshape(4, 2)
+    blobs = {
+        0: {"opt": {"shape": (4, 2), "dtype": "float32",
+                    "pieces": [(((0, 2), (0, 2)), full[:2])]}},
+        1: {"opt": {"shape": (4, 2), "dtype": "float32",
+                    "pieces": [(((2, 4), (0, 2)), full[2:])]}},
+    }
+    reps = {r: {"params": {"shape": (3,), "dtype": "float32",
+                           "pieces": [(((0, 3),), np.ones(3, np.float32))]}}
+            for r in range(2)}
+    boxes = {0: Mailbox(), 1: Mailbox()}
+    escrows: dict = {}
+    mgrs = [_fake_manager(r, 2, blobs, reps, boxes, escrows)
+            for r in range(2)]
+    threads = [threading.Thread(target=m.maybe_tick) for m in mgrs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert set(escrows) == {0, 1}
+    assert all(e["step"] == 2 for e in escrows.values())
+    assert all(m.stats["parity_ticks"] == 1 for m in mgrs)
+    assert all(m.stats["parity_bytes"] > 0 for m in mgrs)
+
+    for dead in (0, 1):
+        surviving = {r: e for r, e in escrows.items() if r != dead}
+        pkg, why = build_recovery(surviving, dead, world=2, k=1)
+        assert pkg is not None, why
+        assert pkg["step"] == 2 and pkg["dead_rank"] == dead
+        got = assemble_leaf(pkg["leaves"]["opt"])
+        np.testing.assert_array_equal(got, full)
+        np.testing.assert_array_equal(
+            assemble_leaf(pkg["leaves"]["params"]),
+            np.ones(3, np.float32))
+
+    # gaps fall back (None + a reason), never raise
+    pkg, why = build_recovery({}, 1, world=2, k=1)
+    assert pkg is None and "no escrow" in why
+    stale = {0: dict(escrows[0], step=1)}
+    pkg, why = build_recovery(stale, 1, world=2, k=1)
+    assert pkg is not None   # single survivor: one common step trivially
+
+
+def test_redundancy_tick_times_out_without_peer_and_skips():
+    """A parity tick whose peer never sends must cost a skipped tick
+    (previous escrow retained), not a wedge or a crash."""
+    from ray_lightning_tpu.cluster.peer import Mailbox
+
+    boxes = {0: Mailbox(), 1: Mailbox()}
+    escrows: dict = {}
+    full = np.zeros((2, 2), np.float32)
+    blobs = {0: {"opt": {"shape": (2, 2), "dtype": "float32",
+                         "pieces": [(((0, 2), (0, 2)), full)]}}}
+    mgr = _fake_manager(0, 2, blobs, {0: {}}, boxes, escrows)
+    mgr.transport.timeout_s = 0.1
+    assert mgr.maybe_tick() is False
+    assert mgr.stats["parity_skipped"] == 1
+    assert 0 not in escrows
+
+
+def test_declared_parity_bytes_counts_only_sharded_leaves():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ray_lightning_tpu.elastic.redundancy import declared_parity_bytes
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("data",))
+    abstract = {"m": jax.ShapeDtypeStruct((8, 2), np.float32),
+                "c": jax.ShapeDtypeStruct((), np.int32)}
+    shardings = {"m": NamedSharding(mesh, P("data")),
+                 "c": NamedSharding(mesh, P())}
+    # (8,2) fp32 = 64B global, 32B/shard; k=1 every=1 -> 32
+    assert declared_parity_bytes(abstract, shardings, 1, 1) == 32
+    assert declared_parity_bytes(abstract, shardings, 2, 4) == 16
 
 
 def test_rebucket_preserves_injected_error_sum():

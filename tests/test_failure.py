@@ -12,15 +12,22 @@ reusability afterwards.
 import logging
 import os
 import signal
+import sys
 import threading
 import time
 
+import cloudpickle
 import pytest
 
 from ray_lightning_tpu import Callback, Trainer
 from ray_lightning_tpu.models import BoringModel
 
 from tests.utils import cpu_plugin
+
+# worker subprocesses cannot import this test module by name; ship the
+# chaos fixture classes (AdamBoring) by value instead (the
+# test_cluster_peer.py seam)
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
 
 
 def _trainer(cb):
@@ -209,6 +216,233 @@ def test_elastic_shrink_to_continue_matches_clean_resume(tmp_path):
         float(np.abs(np.asarray(a)).sum())
         for a in jax.tree_util.tree_leaves(params_elastic))
     assert delta > 0
+
+
+class AdamBoring(BoringModel):
+    """BoringModel with a real optimizer state (Adam moments) so the
+    ZeRO-1 shard a dead rank takes with it is non-trivial — the thing
+    parity redundancy exists to reconstruct."""
+
+    def configure_optimizers(self):
+        import optax
+        return optax.adam(0.05)
+
+
+def _chaos_trainer(tmp_path, snap, *, workers=2, fault=None, elastic=None,
+                   max_steps=8, batch_size=2, resume=None, subdir=""):
+    worker_env = {"RLT_FAULT": fault} if fault else None
+    root = str(tmp_path / subdir) if subdir else str(tmp_path)
+    return Trainer(
+        max_epochs=20, max_steps=max_steps, limit_val_batches=0,
+        num_sanity_val_steps=0, enable_checkpointing=False, seed=0,
+        log_every_n_steps=1, default_root_dir=root,
+        plugins=[cpu_plugin(workers, strategy="zero1",
+                            worker_env=worker_env)],
+        elastic=elastic, resume_from_checkpoint=resume)
+
+
+def _clean_reference_params(tmp_path, stop_step, max_steps=8):
+    """Final params of a fault-free run that mirrors a recovery resumed
+    at ``stop_step``: 2 workers to ``stop_step`` (snapshotting every
+    step), then 1 worker with the doubled batch to ``max_steps`` — the
+    same global batches and the same epoch-replay-from-start semantics
+    as any elastic resume."""
+    snap = str(tmp_path / f"ref_snap_{stop_step}")
+    m1 = AdamBoring(dataset_length=64, batch_size=2)
+    _chaos_trainer(tmp_path, snap, max_steps=stop_step, subdir="ref1",
+                   elastic={"snapshot_every_n_steps": 1,
+                            "snapshot_dir": snap}).fit(m1)
+    m2 = AdamBoring(dataset_length=64, batch_size=4)
+    t2 = _chaos_trainer(tmp_path, snap, workers=1, max_steps=max_steps,
+                        subdir="ref2",
+                        resume=os.path.join(snap, str(stop_step)))
+    t2.fit(m2)
+    assert t2.global_step == max_steps
+    return m2._trained_variables["params"]
+
+
+def test_zero_replay_parity_recovery(tmp_path):
+    """THE zero-replay proof (ISSUE 13 acceptance): a 2-worker ZeRO-1
+    run with parity redundancy on loses rank 1 at step 5.  Durable
+    snapshots exist only at steps 2/4 — yet the run resumes at step 5:
+    the survivor's escrowed state plus the parity block reconstruct the
+    dead optimizer shard in memory, the snapshot directory is never
+    read (``snapshot_restores`` stays 0), and the final parameters
+    equal the clean no-fault reference within the documented 2e-2 bar
+    (observed: allclose at defaults — the escrow is a bit-exact host
+    copy)."""
+    from tests.conftest import assert_tree_allclose
+
+    snap = str(tmp_path / "elastic")
+    module = AdamBoring(dataset_length=64, batch_size=2)
+    trainer = _chaos_trainer(
+        tmp_path, snap, fault="kill:rank=1,step=5",
+        elastic={"snapshot_every_n_steps": 2, "snapshot_dir": snap,
+                 "max_restarts": 2, "redundancy": 1})
+    trainer.fit(module)              # the kill must NOT raise here
+
+    assert trainer.global_step == 8
+    rep = trainer._elastic_report
+    assert rep["recovery"] == "parity"
+    assert rep["restarts"] == 1
+    assert rep["workers"] == 1 and rep["initial_workers"] == 2
+    # resumed PAST the last durable snapshot: with cadence 2 a replay
+    # can only land on an even step — 5 proves in-memory state
+    assert rep["resumed_step"] == 5
+    # zero replay: no sharded restore ran anywhere in the fleet
+    assert rep.get("snapshot_restores", 0) == 0
+    assert rep.get("recovery_seconds", 0) > 0
+
+    reference = _clean_reference_params(tmp_path, stop_step=5)
+    assert_tree_allclose(module._trained_variables["params"], reference,
+                         rtol=2e-2, atol=2e-2)
+
+
+def test_same_fixture_with_redundancy_off_replays(tmp_path):
+    """The PR 7 fallback still stands: identical fault, parity off —
+    recovery routes to snapshot replay from step 4 and the restore
+    counter shows exactly one replay."""
+    from tests.conftest import assert_tree_allclose
+
+    snap = str(tmp_path / "elastic")
+    module = AdamBoring(dataset_length=64, batch_size=2)
+    trainer = _chaos_trainer(
+        tmp_path, snap, fault="kill:rank=1,step=5",
+        elastic={"snapshot_every_n_steps": 2, "snapshot_dir": snap,
+                 "max_restarts": 2})
+    trainer.fit(module)
+
+    assert trainer.global_step == 8
+    rep = trainer._elastic_report
+    assert rep["recovery"] == "replay"
+    # the last DURABLE snapshot: step 4's async write may not have
+    # committed before the kill, in which case step 2 is the truth
+    assert rep["resumed_step"] in (2, 4)
+    assert rep.get("snapshot_restores", 0) == 1
+    reference = _clean_reference_params(tmp_path,
+                                        stop_step=rep["resumed_step"])
+    assert_tree_allclose(module._trained_variables["params"], reference,
+                         rtol=2e-2, atol=2e-2)
+
+
+def test_peerdrop_skips_parity_tick_without_failing(tmp_path):
+    """Lossy-fabric chaos (tier-2 ``peerdrop``): rank 0 swallows the
+    next inbound peer frame after step 2 — its step-3 parity exchange
+    times out and is SKIPPED (previous escrow retained), the fleet
+    never wedges, no restart happens, and later ticks resume."""
+    snap = str(tmp_path / "elastic")
+    module = AdamBoring(dataset_length=64, batch_size=2)
+    trainer = Trainer(
+        max_epochs=20, max_steps=8, limit_val_batches=0,
+        num_sanity_val_steps=0, enable_checkpointing=False, seed=0,
+        log_every_n_steps=1, default_root_dir=str(tmp_path),
+        plugins=[cpu_plugin(
+            2, strategy="zero1",
+            worker_env={"RLT_FAULT": "peerdrop:rank=0,step=2,count=1",
+                        "RLT_ELASTIC_PARITY_TIMEOUT_S": "2"})],
+        elastic={"snapshot_every_n_steps": 4, "snapshot_dir": snap,
+                 "max_restarts": 2, "redundancy": 1})
+    trainer.fit(module)
+    assert trainer.global_step == 8
+    rep = trainer._elastic_report
+    assert rep["restarts"] == 0
+    assert rep.get("parity_skipped", 0) >= 1     # the dropped exchange
+    assert rep.get("parity_ticks", 0) >= 5       # and the recovery after
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", ["kill_rank0", "cadence_boundary",
+                                      "snapkill", "double_kill"])
+def test_chaos_matrix(tmp_path, scenario):
+    """The chaos matrix (ISSUE 13 satellite): every fault shape the
+    tier-2 harness can express ends with a completed run whose params
+    match the clean reference for its resume point within 2e-2 —
+    parity for single-rank loss (including a death ON the snapshot
+    cadence and a death INSIDE the async save), replay fallback for
+    double loss and for the coordinator death that takes the whole
+    fleet (and every escrow) with it."""
+    from tests.conftest import assert_tree_allclose
+
+    snap = str(tmp_path / "elastic")
+    base = {"snapshot_every_n_steps": 2, "snapshot_dir": snap,
+            "max_restarts": 2, "redundancy": 1}
+    if scenario == "kill_rank0":
+        # the driver-adjacent COORDINATOR rank dies (restart=0: a real
+        # preemption does not deterministically repeat after a rewind).
+        # Racy by nature: if rank 1 yields its escrow before its
+        # jax.distributed client aborts, parity reconstructs rank 0;
+        # if the coordinator death takes rank 1 (and its escrow) down
+        # first, the driver must count ONE preemption and replay — it
+        # must never refuse to recover
+        trainer = _chaos_trainer(tmp_path, snap,
+                                 fault="kill:rank=0,step=5,restart=0",
+                                 elastic=base)
+        expect_mode, expect_step, workers = None, 5, 2
+    elif scenario == "cadence_boundary":
+        # death exactly ON the snapshot cadence (step 4): the parity
+        # escrow at step 4 must win over the same-step durable snapshot
+        # (zero restores), not tie-break into a replay
+        trainer = _chaos_trainer(tmp_path, snap,
+                                 fault="kill:rank=1,step=4", elastic=base)
+        expect_mode, expect_step, workers = "parity", 4, 2
+    elif scenario == "snapkill":
+        # rank 1 dies INSIDE its async step-4 save, before completing
+        # its parity send for... step 4 already ticked (parity runs
+        # before the snapshot), so parity still covers step 4 AND the
+        # uncommitted step-4 snapshot must stay invisible to replay
+        trainer = _chaos_trainer(tmp_path, snap,
+                                 fault="snapkill:rank=1,step=4",
+                                 elastic=base)
+        expect_mode, expect_step, workers = "parity", 4, 2
+    else:   # double_kill
+        # two ranks die at once: parity (k=1) cannot cover them —
+        # replay fallback from the last durable snapshot
+        trainer = _chaos_trainer(
+            tmp_path, snap, workers=3,
+            fault="kill:rank=1,step=5;kill:rank=2,step=5",
+            elastic=dict(base, max_restarts=2))
+        expect_mode, expect_step, workers = "replay", 4, 3
+
+    module = AdamBoring(dataset_length=64, batch_size=2)
+    trainer.fit(module)
+    assert trainer.global_step == 8
+    rep = trainer._elastic_report
+    if expect_mode is None:
+        # coordinator-death race (see above): either route is a pass,
+        # as long as the run completed and matches its own reference
+        expect_mode = rep["recovery"]
+        assert expect_mode in ("parity", "replay"), rep
+    assert rep["recovery"] == expect_mode, rep
+    if expect_mode == "parity":
+        assert rep["resumed_step"] == expect_step, rep
+        assert rep.get("snapshot_restores", 0) == 0
+    else:
+        # replay: the last DURABLE snapshot — the cadence hit nearest
+        # the kill may not have committed before the process died
+        assert rep["resumed_step"] in (2, expect_step), rep
+        expect_step = rep["resumed_step"]
+        assert rep.get("snapshot_restores", 0) == 1
+
+    if workers == 2:
+        reference = _clean_reference_params(tmp_path,
+                                            stop_step=expect_step)
+    else:
+        # 3-worker double-kill mirror: 3 clean workers to the resume
+        # step, then the lone survivor at the tripled batch to the end
+        rsnap = str(tmp_path / "ref3")
+        m1 = AdamBoring(dataset_length=64, batch_size=2)
+        _chaos_trainer(tmp_path, rsnap, workers=3, max_steps=expect_step,
+                       subdir="r3a",
+                       elastic={"snapshot_every_n_steps": 1,
+                                "snapshot_dir": rsnap}).fit(m1)
+        m2 = AdamBoring(dataset_length=64, batch_size=6)
+        t2 = _chaos_trainer(tmp_path, rsnap, workers=1, max_steps=8,
+                            subdir="r3b",
+                            resume=os.path.join(rsnap, str(expect_step)))
+        t2.fit(m2)
+        reference = m2._trained_variables["params"]
+    assert_tree_allclose(module._trained_variables["params"],
+                         reference, rtol=2e-2, atol=2e-2)
 
 
 def test_driver_usable_after_worker_failure():
