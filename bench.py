@@ -28,10 +28,19 @@ measured against the stored first-round value below so rounds are
 comparable to each other.  Timing/emission logic lives in
 ``benchmarks/harness.py``, shared with the per-config scripts under
 ``benchmarks/``.
+
+The line also carries ``anatomy`` — the measured per-step device-time
+split (compute/collective/exposed/host, telemetry/anatomy.py) parsed
+from the same warm-tail trace as ``device_ms``.  ``--compare
+prev.json`` (a BENCH_r*.json blob or a file of bench JSON lines) runs
+the perf-regression ledger (benchmarks/ledger.py) over this round's
+records and exits nonzero when step time, device_ms or exposed-comm
+regresses past its band — the pre-merge perf gate.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 
@@ -51,11 +60,28 @@ WARMUP_STEPS = 3
 TIMED_STEPS = 30
 
 
-def main() -> None:
+def main(argv=None) -> int:
+    import argparse
+
     import jax
 
     from benchmarks.harness import run_steps_per_sec
     from ray_lightning_tpu.models.gpt import CONFIGS, GPTLightningModule
+
+    parser = argparse.ArgumentParser(
+        description="Headline bench; --compare turns it into the "
+        "pre-merge perf-regression gate (benchmarks/ledger.py).")
+    parser.add_argument(
+        "--compare", metavar="PREV_JSON", default=None,
+        help="previous round (a BENCH_r*.json blob or a file of bench "
+        "JSON lines); after the run the ledger compares this round's "
+        "records against it and the process exits nonzero when step "
+        "time, device_ms or exposed-comm regresses past its band")
+    parser.add_argument(
+        "--out", metavar="CURR_JSON", default=None,
+        help="also write this round's records as JSON lines (the file "
+        "a later --compare can read)")
+    args = parser.parse_args(argv)
 
     platform = jax.devices()[0].platform
     if platform == "cpu":
@@ -70,9 +96,10 @@ def main() -> None:
     module = GPTLightningModule(
         cfg, dataset_size=batch * (WARMUP_STEPS + TIMED_STEPS + trace_steps),
         batch_size=batch)
-    run_steps_per_sec(module, metric, warmup=WARMUP_STEPS,
-                      timed=TIMED_STEPS, baseline=BASELINES.get(metric),
-                      trace_steps=trace_steps, inline_device_ms=True)
+    results = [run_steps_per_sec(
+        module, metric, warmup=WARMUP_STEPS,
+        timed=TIMED_STEPS, baseline=BASELINES.get(metric),
+        trace_steps=trace_steps, inline_device_ms=True)]
 
     if os.environ.get("RLT_REMAT_AB") == "1":
         # remat-policy ladder (benchmarks/bench_remat.py): compile +
@@ -92,7 +119,25 @@ def main() -> None:
         # multi-device mesh; a single-device session re-runs the legs
         # on the 8-virtual-device CPU proxy in a subprocess.
         from benchmarks.bench_comm import run_comm_ab
-        run_comm_ab(metric + "_comm")
+        comm_results = run_comm_ab(metric + "_comm")
+        if comm_results:
+            results.extend(comm_results)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            for r in results:
+                f.write(json.dumps(r) + "\n")
+
+    if args.compare:
+        # perf-regression ledger (benchmarks/ledger.py): this round's
+        # records vs the given previous round — the pre-merge gate.
+        # Nonzero exit when step time / device_ms / exposed-comm
+        # regresses past its band.
+        from benchmarks import ledger
+        report = ledger.compare(args.compare, results)
+        print(json.dumps(report))
+        return 0 if report["ok"] else 1
+    return 0
 
 
 if __name__ == "__main__":

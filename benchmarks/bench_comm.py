@@ -7,13 +7,23 @@ line in the shared harness format with two extra fields:
 - ``exposed_comm_seconds``: this leg's wall seconds/step minus the
   comm-off (fp32) floor measured in the same process on the same mesh —
   the differential cost the gradient sync ADDS per step after whatever
-  overlap the schedule achieved.  The tentpole's win is the single diff
+  overlap the schedule achieved.  The overlap win is the single diff
   ``int8_bucketed.exposed_comm_seconds <
   int8_barrier.exposed_comm_seconds`` (same codec, same bytes; the only
   difference is the end-of-backward ``optimization_barrier`` the
   barrier leg re-inserts).
 - ``step_seconds``: the raw wall seconds/step the subtraction started
   from, so rounds can recompute against any floor.
+- ``measured_exposed_comm_seconds`` (bucketed/barrier legs): the
+  TRACE-MEASURED exposed comm from a warm-tail capture of the same leg
+  (telemetry/anatomy.py — collective device intervals not overlapped
+  by compute), next to ``exposed_divergence_seconds`` =
+  wall-minus-floor − measured.  The divergence IS a finding: the
+  proxy also pays codec quantize/dequantize compute and host jitter,
+  the measured number is pure serialization — and on this CPU proxy's
+  serial thunk executor measured exposed ≈ collective seconds by
+  construction (no overlap is possible), which is exactly PR 10's
+  caveat made visible in the JSON.
 
 A meaningful A/B needs a real multi-device data mesh.  When the
 current process has one (a TPU slice / multi-host fleet), the legs run
@@ -67,14 +77,15 @@ def _legs(world: int, multi_process: bool):
     return legs
 
 
-def run_comm_ab(metric_prefix: str = "comm_ab") -> None:
+def run_comm_ab(metric_prefix: str = "comm_ab") -> "list | None":
     """Emit every comm A/B leg (inline on a multi-device mesh, else via
-    the CPU-mesh proxy subprocess)."""
+    the CPU-mesh proxy subprocess).  Returns the leg records when run
+    inline (bench.py --compare feeds them to the ledger); None when
+    the subprocess emitted them."""
     import jax
 
     if jax.device_count() >= 2:
-        _run_legs_inline(metric_prefix)
-        return
+        return _run_legs_inline(metric_prefix)
     # single-device session: 8-virtual-device CPU proxy in a child
     # process (the XLA flag must precede backend init, hence the spawn)
     env = dict(os.environ)
@@ -85,9 +96,21 @@ def run_comm_ab(metric_prefix: str = "comm_ab") -> None:
     env["RLT_COMM_AB_METRIC"] = f"{metric_prefix}_cpu_proxy8"
     subprocess.run([sys.executable, "-m", "benchmarks.bench_comm"],
                    env=env, check=True)
+    return None
 
 
-def _run_legs_inline(metric_prefix: str) -> None:
+#: warm-tail dispatches traced on the overlap legs for the measured
+#: exposed-comm figure
+TRACE_STEPS = 4
+
+#: the legs whose measured-vs-proxy divergence the overlap comparison
+#: reads (same codec/bytes; only the barrier differs)
+OVERLAP_LEGS = ("int8_bucketed", "int8_barrier")
+
+
+def _run_legs_inline(metric_prefix: str) -> list:
+    import shutil
+
     import jax
 
     from benchmarks.harness import run_steps_per_sec
@@ -97,15 +120,19 @@ def _run_legs_inline(metric_prefix: str) -> None:
     world = jax.device_count()
     multi = jax.process_count() > 1
     batch = max(8, world)
-    steps = WARMUP + TIMED + 4
+    steps = WARMUP + TIMED + 4 + TRACE_STEPS
 
-    def leg(tag, policy, extra=None):
+    def leg(tag, policy, extra=None, trace_steps=0):
         module = GPTLightningModule("tiny", dataset_size=batch * steps,
                                     batch_size=batch)
         kwargs = {"comm_policy": policy} if policy is not None else {}
-        return run_steps_per_sec(
+        res = run_steps_per_sec(
             module, f"{metric_prefix}_{tag}", warmup=WARMUP, timed=TIMED,
-            trainer_kwargs=kwargs, telemetry=False, extra_fields=extra)
+            trainer_kwargs=kwargs, telemetry=False, extra_fields=extra,
+            trace_steps=trace_steps)
+        if res.get("trace_dir"):
+            shutil.rmtree(res.pop("trace_dir"), ignore_errors=True)
+        return res
 
     # comm-off floor: the same model/mesh with the partitioner's
     # implicit fp32 sync — every leg's exposed seconds subtract it
@@ -114,22 +141,53 @@ def _run_legs_inline(metric_prefix: str) -> None:
 
     def differential(res):
         step_s = 1.0 / res["value"]
-        return {"step_seconds": round(step_s, 6),
-                "exposed_comm_seconds": round(step_s - floor_s, 6)}
+        out = {"step_seconds": round(step_s, 6),
+               "exposed_comm_seconds": round(step_s - floor_s, 6)}
+        measured = (res.get("anatomy") or {}).get("exposed_s")
+        if measured is not None:
+            # trace-measured exposed comm next to the proxy: the
+            # divergence is the quantize/dequantize + host share the
+            # subtraction cannot separate from serialization
+            out["measured_exposed_comm_seconds"] = round(measured, 6)
+            out["exposed_divergence_seconds"] = round(
+                (step_s - floor_s) - measured, 6)
+        return out
 
-    exposed = {}
+    results = [floor]
+    exposed, measured = {}, {}
     for tag, policy in _legs(world, multi):
-        res = leg(tag, policy, extra=differential)
+        res = leg(tag, policy, extra=differential,
+                  trace_steps=TRACE_STEPS if tag in OVERLAP_LEGS else 0)
+        results.append(res)
         exposed[tag] = res["exposed_comm_seconds"]
-    if "int8_bucketed" in exposed and "int8_barrier" in exposed:
-        _metrics.note_exposed_comm(max(exposed["int8_bucketed"], 0.0))
-        print(json.dumps({
+        measured[tag] = res.get("measured_exposed_comm_seconds")
+    if all(t in exposed for t in OVERLAP_LEGS):
+        # the measured figure feeds the gauge when a trace parsed; the
+        # proxy stays the fallback (gauge's source label says which)
+        if measured["int8_bucketed"] is not None:
+            _metrics.note_exposed_comm(max(measured["int8_bucketed"], 0.0),
+                                       source="anatomy")
+        else:
+            _metrics.note_exposed_comm(max(exposed["int8_bucketed"], 0.0))
+        summary = {
             "metric": f"{metric_prefix}_overlap_win",
             "barrier_exposed_s": round(exposed["int8_barrier"], 6),
             "bucketed_exposed_s": round(exposed["int8_bucketed"], 6),
             "overlap_wins": bool(exposed["int8_bucketed"]
                                  < exposed["int8_barrier"]),
-        }))
+            "barrier_measured_exposed_s": measured["int8_barrier"],
+            "bucketed_measured_exposed_s": measured["int8_bucketed"],
+            "note": "exposed_s = wall minus same-process fp32 floor; "
+                    "measured_* = trace-interval overlap "
+                    "(telemetry/anatomy.py).  Divergence between the "
+                    "two is codec compute + host jitter the proxy "
+                    "cannot separate; on the serial CPU proxy measured "
+                    "exposed ≈ collective (no overlap possible — the "
+                    "real-fabric leg is ROADMAP item 5)",
+        }
+        print(json.dumps(summary))
+        results.append(summary)
+    return results
 
 
 def main() -> None:

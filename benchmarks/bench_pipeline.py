@@ -20,7 +20,10 @@ One JSON line per leg in the shared harness format
   family for this boundary shape.
 
 A ``bubble_win`` summary line states the 1f1b-vs-gpipe comparison the
-acceptance bar reads.
+acceptance bar reads — including ``measured_bubble_fraction_1f1b``,
+the trace-anatomy host-gap fraction of the 1f1b leg's own warm-tail
+capture (telemetry/anatomy.py): the measured-bubble leg next to the
+replay-simulated fractions.
 """
 
 from __future__ import annotations
@@ -77,6 +80,8 @@ def main() -> None:
         extra_fields={"stages": STAGES, "microbatches": MICRO,
                       "schedule": "gpipe-spmd"})
 
+    import shutil
+
     results = {}
     for tag, cfg in (
         ("mpmd_gpipe", MpmdConfig(stages=STAGES, schedule="gpipe",
@@ -97,23 +102,37 @@ def main() -> None:
                 c: activation_wire_bytes(boundary, STAGES - 1, MICRO,
                                          codec=c)
                 for c in ("none", "bf16", "int8", "fp8", "int4")}}
+        # measured-bubble leg: the 1f1b run also captures a warm-tail
+        # trace, whose anatomy host-gap fraction is the MEASURED bubble
+        # (telemetry/anatomy.py) next to the replay-simulated one
+        trace_steps = 4 if tag == "mpmd_1f1b" else 0
         results[tag] = run_steps_per_sec(
             _model(), f"{tag}_steps_per_sec", warmup=WARMUP,
             timed=TIMED, strategy=MpmdPipelineStrategy(cfg),
-            telemetry=False, extra_fields=extra)
+            telemetry=False, extra_fields=extra, trace_steps=trace_steps)
+        if results[tag].get("trace_dir"):
+            shutil.rmtree(results[tag].pop("trace_dir"),
+                          ignore_errors=True)
 
     bubbles = results["mpmd_1f1b"].get("mpmd", {}).get(
         "bubble_fraction", {})
+    measured = (results["mpmd_1f1b"].get("anatomy") or {}).get(
+        "bubble_fraction")
     print(json.dumps({
         "metric": "mpmd_bubble_win",
         "gpipe_bubble_fraction": bubbles.get("gpipe"),
         "1f1b_bubble_fraction": bubbles.get("1f1b"),
         "1f1b_below_gpipe": (
             bubbles.get("1f1b", 1.0) < bubbles.get("gpipe", 0.0)),
+        "measured_bubble_fraction_1f1b": measured,
         "microbatches": MICRO,
-        "note": "simulated from measured per-op seconds; 1f1b "
-                "interleaves (v=2) — plain 1f1b ties gpipe "
-                "(mpmd/schedule.py)",
+        "note": "bubble_fraction legs are simulated from measured "
+                "per-op seconds; measured_bubble_fraction_1f1b is the "
+                "trace-anatomy host-gap share of the same run "
+                "(telemetry/anatomy.py) — on the serial CPU proxy it "
+                "measures dispatch gap, the real-fabric leg is ROADMAP "
+                "item 1c.  1f1b interleaves (v=2) — plain 1f1b ties "
+                "gpipe (mpmd/schedule.py)",
     }))
 
 

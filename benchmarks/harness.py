@@ -44,6 +44,13 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
     ``telemetry_jsonl`` in the JSON line, so a BENCH regression can be
     attributed to a phase (step vs data_wait vs compile) from the span
     stream instead of re-running under a profiler.
+
+    Any captured warm-tail trace additionally lands as an ``anatomy``
+    field (telemetry/anatomy.py): the MEASURED per-step device-time
+    split — compute / collective (by op + ici/dcn link) /
+    trace-measured exposed comm / host gap — so every leg's claim is
+    one JSON diff against the previous round
+    (``bench.py --compare`` / benchmarks/ledger.py gates on it).
     """
     from ray_lightning_tpu import Trainer
     from ray_lightning_tpu.core.callbacks import Callback
@@ -163,6 +170,18 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
             "activation_bytes_per_step":
                 rep.get("activation_bytes_per_step"),
         }
+    if timer.trace_dir is not None:
+        # measured step anatomy from the warm-tail trace
+        # (telemetry/anatomy.py): where the device time of THIS leg's
+        # steps actually went — compute / collective (by op and
+        # ici/dcn link) / trace-measured exposed comm / host gap.
+        # Parsed before the device_ms path below consumes the dir.
+        from ray_lightning_tpu.telemetry.anatomy import (
+            parse_anatomy_or_none,
+        )
+        anatomy = parse_anatomy_or_none(timer.trace_dir)
+        if anatomy is not None:
+            result["anatomy"] = anatomy
     paths = getattr(trainer, "_telemetry_paths", None)
     if paths:
         result["telemetry_jsonl"] = paths["jsonl"]

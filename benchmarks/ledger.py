@@ -1,0 +1,179 @@
+"""Perf-regression ledger: turn bench JSON trajectories into a gate.
+
+The repo accumulates one measured JSON blob per round (the driver's
+``BENCH_r*.json``, any ``bench.py``-family output) but until now a
+regression was something a human noticed diffing them.  This module
+compares two rounds record-by-record and exits nonzero when a tracked
+figure regresses past its band — the pre-merge perf gate
+(``python bench.py --compare BENCH_r05.json`` or
+``python -m benchmarks.ledger prev.json curr.json``).
+
+Accepted inputs, auto-detected per file:
+
+- a driver ``BENCH_r*.json`` blob (``{"parsed": {...}, "tail": "..."}``
+  — every JSON object line in ``tail`` is a record, ``parsed`` too);
+- a file of JSON lines (one record per line, non-JSON lines ignored);
+- one JSON object / array of objects.
+
+Records join on their ``metric`` name.  Tracked figures and their
+regression direction:
+
+==============================  ======  ==============================
+figure                          worse    band
+==============================  ======  ==============================
+``value`` (steps/sec legs)      lower   ``step_band`` (default 5%)
+``device_ms``                   higher  ``step_band``
+``exposed_comm_seconds`` /
+``measured_exposed_comm_seconds``  higher  ``exposed_band`` (default
+                                        10%) + ``min_exposed_s``
+                                        absolute floor, so sub-ms CPU
+                                        noise never trips the gate
+==============================  ======  ==============================
+
+Improvements are reported too (the ledger is a trajectory, not just an
+alarm); metrics present on only one side are listed as uncompared so a
+silently dropped leg can't read as "no regression".
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable
+
+#: default relative bands (fraction of the previous value)
+STEP_BAND = 0.05
+EXPOSED_BAND = 0.10
+#: absolute floor under which exposed-comm drift is noise, not signal
+MIN_EXPOSED_S = 1e-4
+
+
+def _iter_records(obj: Any) -> Iterable[dict]:
+    """Yield every bench record (dict with a ``metric`` key) inside an
+    arbitrary loaded JSON value / raw text blob."""
+    if isinstance(obj, dict):
+        if "metric" in obj:
+            yield obj
+        for key in ("parsed",):
+            if isinstance(obj.get(key), dict):
+                yield from _iter_records(obj[key])
+        tail = obj.get("tail")
+        if isinstance(tail, str):
+            yield from _iter_text(tail)
+    elif isinstance(obj, list):
+        for item in obj:
+            yield from _iter_records(item)
+
+
+def _iter_text(text: str) -> Iterable[dict]:
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        yield from _iter_records(obj)
+
+
+def load_records(source: Any) -> dict[str, dict]:
+    """``metric name → record`` from a path, loaded JSON value, or a
+    list of record dicts (later duplicates win — the newest emission
+    of a re-run leg is the round's figure)."""
+    if isinstance(source, str):
+        with open(source) as f:
+            text = f.read()
+        try:
+            records = list(_iter_records(json.loads(text)))
+        except ValueError:
+            records = list(_iter_text(text))
+    else:
+        records = list(_iter_records(source))
+    return {r["metric"]: r for r in records}
+
+
+def _exposed_of(rec: dict) -> "float | None":
+    """The record's exposed-comm figure, measured preferred."""
+    v = rec.get("measured_exposed_comm_seconds")
+    if v is None:
+        v = rec.get("exposed_comm_seconds")
+    return None if v is None else float(v)
+
+
+def compare(prev: Any, curr: Any, *, step_band: float = STEP_BAND,
+            exposed_band: float = EXPOSED_BAND,
+            min_exposed_s: float = MIN_EXPOSED_S) -> dict:
+    """Compare two rounds; the returned report's ``ok`` is the gate.
+
+    ``prev``/``curr``: anything :func:`load_records` accepts.
+    """
+    prev_by = load_records(prev)
+    curr_by = load_records(curr)
+    regressions: list[dict] = []
+    improvements: list[dict] = []
+    compared = 0
+
+    def check(metric, figure, old, new, worse_is, band, floor=0.0):
+        nonlocal compared
+        if old is None or new is None or old <= 0:
+            return
+        compared += 1
+        delta = (new - old) / old
+        worse = delta if worse_is == "higher" else -delta
+        row = {"metric": metric, "figure": figure,
+               "prev": old, "curr": new, "delta_pct": round(delta * 100, 2)}
+        if worse > band and abs(new - old) > floor:
+            regressions.append(row)
+        elif worse < -band:
+            improvements.append(row)
+
+    for metric in sorted(set(prev_by) & set(curr_by)):
+        p, c = prev_by[metric], curr_by[metric]
+        if p.get("unit") == "steps/sec" and c.get("unit") == "steps/sec":
+            check(metric, "steps_per_sec", p.get("value"), c.get("value"),
+                  "lower", step_band)
+        if p.get("device_ms") is not None and c.get("device_ms") is not None:
+            check(metric, "device_ms", p["device_ms"], c["device_ms"],
+                  "higher", step_band)
+        pe, ce = _exposed_of(p), _exposed_of(c)
+        if pe is not None and ce is not None:
+            check(metric, "exposed_comm_seconds", pe, ce, "higher",
+                  exposed_band, floor=min_exposed_s)
+    report = {
+        "metric": "perf_ledger",
+        "compared": compared,
+        "regressions": regressions,
+        "improvements": improvements,
+        "only_prev": sorted(set(prev_by) - set(curr_by)),
+        "only_curr": sorted(set(curr_by) - set(prev_by)),
+        "bands": {"step": step_band, "exposed": exposed_band,
+                  "min_exposed_s": min_exposed_s},
+        "ok": not regressions,
+    }
+    return report
+
+
+def main(argv: list) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m benchmarks.ledger",
+        description="Compare two bench JSON rounds; exit 1 on regression.")
+    parser.add_argument("prev", help="previous round (BENCH_r*.json or "
+                        "a file of bench JSON lines)")
+    parser.add_argument("curr", help="current round, same formats")
+    parser.add_argument("--step-band", type=float, default=STEP_BAND,
+                        help="relative band for steps/sec + device_ms "
+                        f"(default {STEP_BAND})")
+    parser.add_argument("--exposed-band", type=float, default=EXPOSED_BAND,
+                        help="relative band for exposed-comm seconds "
+                        f"(default {EXPOSED_BAND})")
+    args = parser.parse_args(argv)
+    report = compare(args.prev, args.curr, step_band=args.step_band,
+                     exposed_band=args.exposed_band)
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":   # pragma: no cover - exercised via bench.py
+    import sys
+    sys.exit(main(sys.argv[1:]))

@@ -1,26 +1,40 @@
 """Device-trace capture + bucketed breakdown for TPU benchmarking.
 
-Wraps ``jax.profiler.trace`` and parses the emitted Chrome-trace JSON to
-answer two questions the wall clock cannot (the tunnel between host and
-chip adds tens of ms of jitter per dispatch):
+Wraps ``jax.profiler.trace`` and derives per-op/per-module figures from
+the emitted Chrome-trace JSON to answer two questions the wall clock
+cannot (the tunnel between host and chip adds tens of ms of jitter per
+dispatch):
 
 - where does *device* time go per step (op-category buckets)?
 - what is the pure device time per step (compute + collectives), for
   framework-vs-native ratios that hold even when the host link drifts?
 
-Used by ``bench_native_baseline.py`` (device-time ratio legs) and the
-ad-hoc perf work recorded in benchmarks/README.md.
+The trace PARSING itself — file locator, track/thread-layout handling,
+the category-bucketing table — lives in
+``ray_lightning_tpu/telemetry/anatomy.py`` (ONE parser for the whole
+repo; the anatomy plane, the profile controllers and these bench
+helpers all read traces through it).  This module keeps the
+bench-facing derivations: roofline, breakdown, top-ops, dominant
+module.  Used by ``bench_native_baseline.py`` (device-time ratio legs),
+``profile_headline.py`` and the ad-hoc perf work in
+benchmarks/README.md.
 """
 
 from __future__ import annotations
 
 import collections
-import glob
-import gzip
-import json
-import os
 import tempfile
 from typing import Callable
+
+from ray_lightning_tpu.telemetry.anatomy import (  # noqa: F401  (re-export)
+    bucket_of,
+    device_track_events,
+    locate_trace_json,
+)
+
+#: legacy aliases (pre-anatomy private names, kept for ad-hoc scripts)
+_latest_trace_json = locate_trace_json
+_device_events = device_track_events
 
 
 def capture_trace(run: Callable[[], None], out_dir: str | None = None) -> str:
@@ -31,43 +45,6 @@ def capture_trace(run: Callable[[], None], out_dir: str | None = None) -> str:
     with jax.profiler.trace(out_dir):
         run()
     return out_dir
-
-
-def _latest_trace_json(trace_dir: str) -> str:
-    paths = sorted(glob.glob(os.path.join(
-        trace_dir, "plugins", "profile", "*", "*.trace.json.gz")))
-    if not paths:
-        raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
-    return paths[-1]
-
-
-def _device_events(trace_path: str, track: str = "XLA Ops") -> list[dict]:
-    """Complete ('X') events on one device-side track.
-
-    Device processes are named ``/device:TPU:0`` etc. and carry nested
-    tracks — "Steps" ⊃ "XLA Modules" ⊃ "XLA Ops" — so callers must pick
-    ONE track or they double-count: per-op analysis wants "XLA Ops",
-    per-step wall time wants "XLA Modules".
-    """
-    with gzip.open(trace_path, "rt") as f:
-        data = json.load(f)
-    events = data.get("traceEvents", [])
-    proc_names: dict = {}
-    thread_names: dict = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            proc_names[e.get("pid")] = e.get("args", {}).get("name", "")
-        if e.get("ph") == "M" and e.get("name") == "thread_name":
-            thread_names[(e.get("pid"), e.get("tid"))] = \
-                e.get("args", {}).get("name", "")
-
-    def on_track(e) -> bool:
-        pname = proc_names.get(e.get("pid"), "")
-        tname = thread_names.get((e.get("pid"), e.get("tid")), "")
-        return "/device:" in pname and tname == track
-
-    return [e for e in events
-            if e.get("ph") == "X" and e.get("dur") and on_track(e)]
 
 
 def roofline(trace_dir: str, steps: int, *,
@@ -86,7 +63,7 @@ def roofline(trace_dir: str, steps: int, *,
     count, tflops, gbps, bound_frac, bound_by}.
     """
     agg: dict[str, dict] = {}
-    for e in _device_events(_latest_trace_json(trace_dir)):
+    for e in device_track_events(locate_trace_json(trace_dir)):
         args = e.get("args", {})
         # deduplicated_name: XLA emitted one program for several
         # identical ops (e.g. the 12 per-layer attention kernels);
@@ -115,35 +92,10 @@ def roofline(trace_dir: str, steps: int, *,
     return rows
 
 
-def bucket_of(name: str) -> str:
-    """Coarse op-category for a device event name (HLO-ish)."""
-    n = name.lower()
-    if "pallas" in n or "custom-call" in n or "flash" in n:
-        return "pallas/custom"
-    if "convert" in n:
-        return "convert-fusion"
-    if "all-reduce" in n or "all-gather" in n or "reduce-scatter" in n \
-            or "collective" in n or "permute" in n:
-        return "collective"
-    if "multiply" in n and ("reduce" in n or "subtract" in n):
-        return "multiply-reduce-fusion"
-    if n.startswith("fusion") or ".fusion" in n:
-        return "generic-fusion"
-    if "dot" in n or "dense" in n or "conv" in n:
-        return "dot/conv"
-    if "copy" in n or "bitcast" in n or "transpose" in n:
-        return "copy/layout"
-    if "dynamic" in n or "gather" in n or "scatter" in n or "slice" in n:
-        return "gather/scatter"
-    if "reduce" in n or "add" in n:
-        return "reduce/add"
-    return "other"
-
-
 def device_breakdown(trace_dir: str) -> dict[str, float]:
     """Total device time (ms) per bucket across the whole trace."""
     out: dict[str, float] = collections.defaultdict(float)
-    for e in _device_events(_latest_trace_json(trace_dir)):
+    for e in device_track_events(locate_trace_json(trace_dir)):
         out[bucket_of(e["name"])] += e["dur"] / 1000.0
     return dict(sorted(out.items(), key=lambda kv: -kv[1]))
 
@@ -152,7 +104,7 @@ def top_ops(trace_dir: str, k: int = 25) -> list[tuple[str, float, int]]:
     """(name, total ms, count) for the k most expensive device ops."""
     tot: dict[str, float] = collections.defaultdict(float)
     cnt: dict[str, int] = collections.defaultdict(int)
-    for e in _device_events(_latest_trace_json(trace_dir)):
+    for e in device_track_events(locate_trace_json(trace_dir)):
         tot[e["name"]] += e["dur"] / 1000.0
         cnt[e["name"]] += 1
     ranked = sorted(tot.items(), key=lambda kv: -kv[1])[:k]
@@ -173,8 +125,8 @@ def dominant_module(trace_dir: str) -> tuple[str, float, int]:
     """
     import statistics
 
-    evs = _device_events(_latest_trace_json(trace_dir),
-                         track="XLA Modules")
+    evs = device_track_events(locate_trace_json(trace_dir),
+                              track="XLA Modules")
     agg: dict[str, list] = collections.defaultdict(list)
     for e in evs:
         agg[e["name"]].append(e["dur"] / 1000.0)
@@ -214,6 +166,7 @@ def total_device_ms(trace_dir: str, module_filter: str = "") -> float:
     tunnel jitter.  ``module_filter``: only count modules whose name
     contains it (e.g. "train_step" to exclude init/eval programs).
     """
-    evs = _device_events(_latest_trace_json(trace_dir), track="XLA Modules")
+    evs = device_track_events(locate_trace_json(trace_dir),
+                              track="XLA Modules")
     return sum(e["dur"] / 1000.0 for e in evs
                if module_filter in e.get("name", ""))

@@ -77,8 +77,11 @@ if [[ "$CHECK" == 1 ]]; then
     # trace-plane selfcheck: span-record schema, trace-context
     # round-trip (driver + worker spans reassemble one request tree),
     # flight-recorder bounded-size invariant, profile-controller state
-    # machine, trace-plane metric names
-    # (ray_lightning_tpu/telemetry/selfcheck.py)
+    # machine, trace-plane + anatomy metric names, the anatomy parser
+    # on the golden synthetic fixture (exposed-comm overlap math + the
+    # wall = compute + exposed + host identity), and the
+    # TelemetryConfig anatomy knobs round-tripping through
+    # worker_env/RLT_ANATOMY* (ray_lightning_tpu/telemetry/selfcheck.py)
     python -c 'import sys; from ray_lightning_tpu.telemetry.selfcheck \
         import _main; sys.exit(_main([]))'
 fi

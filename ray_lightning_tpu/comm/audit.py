@@ -197,6 +197,41 @@ def declared_dcn_bytes(op_bytes: dict, multi_process: bool) -> int:
     return int(dcn)
 
 
+# -- trace-event classification (telemetry/anatomy.py) ---------------------
+
+
+def collective_kind(name: str) -> "str | None":
+    """The COLLECTIVE_OPS kind of a device-trace event name
+    (``"all-reduce.3"`` → ``"all-reduce"``, fusion wrappers included by
+    substring), or None for a non-collective event.  This is the ONE
+    collective-name classification — the trace-anatomy parser and the
+    HLO byte audit above must never disagree on what counts as comm."""
+    n = name.lower()
+    for op in COLLECTIVE_OPS:
+        if op in n:
+            return op
+    return None
+
+
+def event_link(args: dict, ici_size: int, multi_process: bool) -> str:
+    """``"ici"`` or ``"dcn"`` for one collective trace event.
+
+    TPU device traces carry the lowered HLO (``long_name`` /
+    ``hlo_text`` args) including ``replica_groups=...`` — when present,
+    the same group parser + :func:`crosses_dcn` test the byte audit
+    uses decides the link.  Without groups the topology decides: a
+    multi-process mesh's group-less collective spans hosts by
+    definition (matching :func:`crosses_dcn`'s group-less rule), a
+    single-process mesh has no DCN hop at all."""
+    text = " ".join(str(v) for v in (args or {}).values()
+                    if isinstance(v, str))
+    if "replica_groups=" in text:
+        groups = _parse_groups(text)
+        if groups is not None:
+            return "dcn" if crosses_dcn(groups, ici_size) else "ici"
+    return "dcn" if multi_process else "ici"
+
+
 # -- byte → seconds (planner cost model) -----------------------------------
 
 #: modeled payload bandwidths, in GB/s (1e9 bytes/s), of the two link

@@ -46,6 +46,7 @@ import numpy as np
 
 from ray_lightning_tpu.telemetry import span
 from ray_lightning_tpu.telemetry import metrics as _metrics
+from ray_lightning_tpu.telemetry.anatomy import anatomy_tick
 from ray_lightning_tpu.telemetry.tracing import profile_tick
 
 _log = logging.getLogger(__name__)
@@ -204,8 +205,10 @@ class StreamSource:
 
     def run_one(self, trainer, item: Item):
         # on-demand profile window (POST /debug/profile → control file,
-        # telemetry/tracing.py): one global check when disarmed
+        # telemetry/tracing.py) + cadence-armed anatomy window
+        # (telemetry/anatomy.py): one global check each when disarmed
         profile_tick()
+        anatomy_tick()
         if item.device is not None:
             gbatch = item.device
         else:
@@ -215,6 +218,7 @@ class StreamSource:
 
     def run_chunk(self, trainer, items: list):
         profile_tick()
+        anatomy_tick()
         stacked = jax.tree_util.tree_map(
             lambda *xs: np.stack(xs), *[it.payload for it in items])
         gbatch = trainer._put_batch(stacked, self._strategy, stacked=True)
@@ -560,6 +564,7 @@ class CachedSource:
 
     def run_one(self, trainer, item: Item):
         profile_tick()
+        anatomy_tick()
         if item.kind == "host":
             gbatch = trainer._put_batch(item.payload, self._strategy)
             trainer.state, metrics = trainer._train_step(
@@ -571,6 +576,7 @@ class CachedSource:
 
     def run_chunk(self, trainer, items: list):
         profile_tick()
+        anatomy_tick()
         idxs = np.asarray([it.payload for it in items], dtype=np.int32)
         trainer.state, metrics = trainer._cached_multi_step(
             trainer.state, self._repacked, idxs)

@@ -58,6 +58,13 @@ class LocalPlugin(ExecutionPlugin):
         telemetry.set_active(agg)
         telemetry.enable(rank=0, sink=lambda recs: agg.ingest_records(
             0, recs), capacity=cfg.capacity, flush_every=cfg.flush_every)
+        every_n, window = cfg.resolved_anatomy()
+        if every_n is not None:
+            # cadence-armed anatomy windows (telemetry/anatomy.py): the
+            # "worker" is this process, so the compact dict lands on
+            # the aggregator directly
+            telemetry.enable_anatomy(rank=0, every_n=every_n,
+                                     window=window, sink=agg.maybe_ingest)
         server = None
         profile_env_set = False
         if cfg.metrics:
@@ -78,6 +85,7 @@ class LocalPlugin(ExecutionPlugin):
         try:
             return trainer._run_stage(module, datamodule, stage, ckpt_path)
         finally:
+            telemetry.disable_anatomy()
             telemetry.flush_metrics()
             telemetry.disable_metrics()
             telemetry.flush()

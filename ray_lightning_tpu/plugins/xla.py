@@ -166,6 +166,14 @@ def _setup_worker_telemetry(trainer, rank: int, queue):
             rank=rank,
             sink=lambda item, _q=queue, _rank=rank: _q.put((_rank, item)),
             interval=cfg.metrics_interval)
+    every_n, window = cfg.resolved_anatomy()
+    if every_n is not None:
+        # cadence-armed anatomy windows (telemetry/anatomy.py): each
+        # rank captures + parses its OWN trace and ships only the
+        # compact anatomy dict over the queue — never the raw capture
+        telemetry.enable_anatomy(
+            rank=rank, every_n=every_n, window=window,
+            sink=lambda item, _q=queue, _rank=rank: _q.put((_rank, item)))
     if hb_mod.process_heartbeat_active():
         return None  # worker_main (built-in backend) already beats
     return hb_mod.HeartbeatSender(
@@ -178,8 +186,11 @@ def _teardown_worker_telemetry(trainer, hb) -> None:
     if cfg is None or not cfg.enabled:
         return
     from ray_lightning_tpu import telemetry
-    # final metrics window first: its cumulative counters must be on the
-    # queue before the spans flush that follows the last step
+    # abandon any mid-capture anatomy window first (a partial trace is
+    # not an anatomy), then the final metrics window: its cumulative
+    # counters must be on the queue before the spans flush that follows
+    # the last step
+    telemetry.disable_anatomy()
     telemetry.flush_metrics()
     telemetry.disable_metrics()
     telemetry.flush()
@@ -317,6 +328,10 @@ class RayXlaPlugin(ExecutionPlugin):
             # record spans once the fit payload arrives (_worker_run)
             base_env["RLT_TELEMETRY"] = "1"
             base_env["RLT_HEARTBEAT_INTERVAL"] = str(cfg.heartbeat_interval)
+            # anatomy cadence (RLT_ANATOMY* — telemetry/anatomy.py):
+            # every rank must arm the same windows the driver resolved,
+            # whether the cadence came from the config or the env
+            base_env.update(cfg.worker_env())
             if cfg.metrics and getattr(backend, "shared_filesystem",
                                        False):
                 # on-demand profiling for fits (POST /debug/profile):

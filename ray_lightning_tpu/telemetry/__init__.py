@@ -55,6 +55,17 @@ from ray_lightning_tpu.telemetry.tracing import (  # noqa: F401
     profile_tick,
     record_request_span,
 )
+from ray_lightning_tpu.telemetry.anatomy import (  # noqa: F401
+    AnatomyController,
+    StepAnatomy,
+    anatomy_item,
+    anatomy_tick,
+    disable_anatomy,
+    enable_anatomy,
+    get_anatomy_controller,
+    parse_anatomy_or_none,
+    parse_trace_anatomy,
+)
 from ray_lightning_tpu.telemetry.metrics import (  # noqa: F401
     MetricsRegistry,
     disable_metrics,
@@ -104,6 +115,15 @@ __all__ = [
     "note_step_collectives",
     "on_step",
     "on_compile",
+    "StepAnatomy",
+    "AnatomyController",
+    "anatomy_item",
+    "anatomy_tick",
+    "enable_anatomy",
+    "disable_anatomy",
+    "get_anatomy_controller",
+    "parse_trace_anatomy",
+    "parse_anatomy_or_none",
 ]
 
 
@@ -137,6 +157,15 @@ class TelemetryConfig:
     #: JSON).  None = no server unless RLT_METRICS_PORT is set; 0 = an
     #: ephemeral port (read it back from the returned metrics_url)
     metrics_port: Optional[int] = None
+    #: anatomy plane (telemetry/anatomy.py): every N dispatches each
+    #: rank arms a short jax.profiler window, parses its own capture
+    #: locally into a StepAnatomy (measured compute/collective/exposed/
+    #: host split) and ships only the compact dict to the driver.
+    #: None = disarmed unless RLT_ANATOMY / RLT_ANATOMY_EVERY_N_STEPS
+    #: arm it (resolved_anatomy below)
+    anatomy_every_n_steps: Optional[int] = None
+    #: dispatches traced per anatomy window
+    anatomy_steps: int = 4
 
     @classmethod
     def resolve(cls, value: Any) -> "TelemetryConfig":
@@ -174,6 +203,47 @@ class TelemetryConfig:
                     "RLT_METRICS_PORT=%r is not an integer; metrics "
                     "endpoint disabled", env)
         return None
+
+    def resolved_anatomy(self) -> "tuple[Optional[int], int]":
+        """(every_n_dispatches, window_dispatches) with the RLT_ANATOMY*
+        env merged in: the explicit config field wins, else
+        ``RLT_ANATOMY_EVERY_N_STEPS``, else bare ``RLT_ANATOMY=1`` arms
+        the default cadence.  (None, window) = disarmed."""
+        from ray_lightning_tpu.telemetry import anatomy as _anatomy
+        every = self.anatomy_every_n_steps
+        if every is None:
+            env = os.environ.get(_anatomy.ANATOMY_EVERY_ENV, "").strip()
+            if env:
+                try:
+                    every = int(env)
+                except ValueError:
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "%s=%r is not an integer; anatomy disarmed",
+                        _anatomy.ANATOMY_EVERY_ENV, env)
+            elif os.environ.get(_anatomy.ANATOMY_ENV, "") in ("1", "true"):
+                every = _anatomy.DEFAULT_EVERY_N
+        steps = self.anatomy_steps
+        env = os.environ.get(_anatomy.ANATOMY_STEPS_ENV, "").strip()
+        if env:
+            try:
+                steps = int(env)
+            except ValueError:
+                pass
+        if every is not None and every <= 0:
+            every = None
+        return every, max(1, int(steps))
+
+    def worker_env(self) -> dict:
+        """Env knobs actor fleets must inherit so every rank arms the
+        same anatomy cadence the driver resolved (ships in the plugin's
+        base worker env like the RLT_COMM*/RLT_PLAN* knobs)."""
+        from ray_lightning_tpu.telemetry import anatomy as _anatomy
+        every, steps = self.resolved_anatomy()
+        if every is None:
+            return {}
+        return {_anatomy.ANATOMY_EVERY_ENV: str(every),
+                _anatomy.ANATOMY_STEPS_ENV: str(steps)}
 
     def resolve_dir(self, default_root_dir: str) -> str:
         if self.dir:

@@ -116,6 +116,10 @@ class TelemetryAggregator:
         self._metric_windows_dropped = 0
         self._metrics_latest: dict[int, dict] = {}
         self._metrics_first_ts: dict[int, float] = {}
+        #: anatomy plane (telemetry/anatomy.py): latest measured
+        #: per-step breakdown per rank + total windows ingested
+        self._anatomy_latest: dict[int, dict] = {}
+        self._anatomy_windows = 0
         #: elastic plane: per-rank liveness verdicts + the cumulative
         #: shrink-to-continue restart count, exported as driver-side
         #: (rank -1) series so /metrics shows FLEET health, not just
@@ -144,7 +148,37 @@ class TelemetryAggregator:
             self._note_heartbeat(item)
         elif kind == "metrics":
             self.ingest_metrics(item)
+        elif kind == "anatomy":
+            self.ingest_anatomy(item)
         return True
+
+    def ingest_anatomy(self, item: dict) -> None:
+        """One rank's compact step anatomy (telemetry/anatomy.py): keep
+        the latest per rank for /status + the export summary, and
+        mirror it into the flight recorder so a crash's black box
+        carries where THAT rank's device time was going."""
+        rank = item.get("rank", -1)
+        anatomy = item.get("anatomy") or {}
+        with self._lock:
+            self._anatomy_latest[rank] = dict(anatomy)
+            self._anatomy_windows += 1
+        self.flight.note_anatomy(rank, anatomy)
+
+    def anatomy_stats(self) -> dict:
+        """Per-rank measured step anatomy + straggler skew (slowest
+        rank's measured step wall / fastest's) — the ``anatomy``
+        section of /status and the export summary."""
+        with self._lock:
+            latest = {str(r): dict(a)
+                      for r, a in sorted(self._anatomy_latest.items())}
+            windows = self._anatomy_windows
+        if not latest:
+            return {}
+        out: dict[str, Any] = {"per_rank": latest, "windows": windows}
+        walls = [a.get("wall_s", 0.0) for a in latest.values()]
+        if len(walls) >= 2 and min(walls) > 0:
+            out["straggler_skew"] = round(max(walls) / min(walls), 3)
+        return out
 
     def ingest_metrics(self, item: dict) -> None:
         """One cumulative metrics window from a rank: keep the stream
@@ -663,6 +697,11 @@ class TelemetryAggregator:
             }
         if self.flight.dumped:
             summary["flight_dumps"] = dict(self.flight.dumped)
+        anatomy = self.anatomy_stats()
+        if anatomy:
+            # measured step-time truth (telemetry/anatomy.py): where
+            # device time went per rank, from real profiler captures
+            summary["anatomy"] = anatomy
         collectives = self.collective_stats()
         hbm = self.hbm_stats()
         dropped = self.dropped_stats()

@@ -108,6 +108,13 @@ def render_status(aggregator, profile_controller=None) -> dict:
         entry["step_p95_ms"] = st.get("p95_ms")
         entry["steps_recorded"] = st.get("steps")
     doc: dict = {"ranks": ranks}
+    anatomy = aggregator.anatomy_stats()
+    if anatomy:
+        # anatomy plane (telemetry/anatomy.py): per-rank MEASURED step
+        # breakdown (compute/collective/exposed/host, collectives split
+        # by op and ici/dcn link) parsed from real profiler captures on
+        # the ranks themselves, plus straggler skew on measured wall
+        doc["anatomy"] = anatomy
     tenants = aggregator.tenant_breakdown()
     if tenants:
         # per-request trace plane: TTFT/TPOT with queue vs prefill vs

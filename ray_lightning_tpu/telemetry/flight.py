@@ -59,6 +59,7 @@ class FlightRecorder:
         self._records: dict[int, deque] = {}
         self._beats: dict[int, deque] = {}
         self._briefs: dict[int, dict] = {}
+        self._anatomy: dict[int, dict] = {}
         #: rank -> path of the last dump (status/test surface)
         self.dumped: dict[int, str] = {}
 
@@ -83,6 +84,13 @@ class FlightRecorder:
     def note_metrics_brief(self, rank: int, brief: Optional[dict]) -> None:
         if brief:
             self._briefs[rank] = dict(brief)
+
+    def note_anatomy(self, rank: int, anatomy: Optional[dict]) -> None:
+        """Latest measured step anatomy (telemetry/anatomy.py) — the
+        black box then says where the rank's device time was going,
+        not just which span it died in."""
+        if anatomy:
+            self._anatomy[rank] = dict(anatomy)
 
     # -- evidence surface ------------------------------------------------
 
@@ -110,6 +118,7 @@ class FlightRecorder:
             "heartbeats": beats,
             "last_heartbeat_wall": beats[-1]["wall"] if beats else None,
             "metrics_brief": self._briefs.get(rank),
+            "anatomy": self._anatomy.get(rank),
             "capacity": {"spans": self.span_capacity,
                          "heartbeats": self.beat_capacity},
         }

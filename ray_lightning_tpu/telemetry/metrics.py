@@ -80,9 +80,26 @@ CORE_METRICS = (
     "rlt_collective_seconds_total",
     # comm plane (comm/collectives.py hierarchical sync): bytes the
     # step's declared collectives push across the slow DCN tier, and
-    # the bench-measured exposed (non-overlapped) comm seconds per step
+    # the exposed (non-overlapped) comm seconds per step.  The exposed
+    # gauge carries a ``source`` label naming its provenance:
+    # ``anatomy`` = measured from trace-event overlap on the device
+    # timelines during instrumented runs (telemetry/anatomy.py — the
+    # number of record); ``wall_minus_floor`` = bench_comm.py's
+    # differential proxy (leg wall minus the same-process fp32 floor,
+    # which also pays codec quantize/dequantize compute)
     "rlt_comm_dcn_bytes_total",
     "rlt_comm_exposed_seconds",
+    # anatomy plane (telemetry/anatomy.py AnatomyController): measured
+    # per-step device-time split from cadence-armed profiler windows,
+    # each rank parsing its own capture — compute / collective
+    # (overlap-inclusive) / exposed (trace-measured non-overlapped) /
+    # host gap, the DCN-link share, and completed windows
+    "rlt_anatomy_compute_seconds",
+    "rlt_anatomy_collective_seconds",
+    "rlt_anatomy_exposed_seconds",
+    "rlt_anatomy_host_seconds",
+    "rlt_anatomy_dcn_seconds",
+    "rlt_anatomy_windows_total",
     "rlt_data_wait_seconds_total",
     "rlt_telemetry_dropped_total",
     # trace plane (telemetry/tracing.py + serve per-request tracing):
@@ -512,16 +529,26 @@ def on_step(duration_s: float, k: int = 1,
             reg.traced_dcn_bytes * k)
 
 
-def note_exposed_comm(seconds: float) -> None:
-    """Record the measured EXPOSED (non-overlapped) comm seconds per
-    step — what a bench A/B leg pays at the sync barrier after overlap
-    is accounted for (benchmarks/bench_comm.py sets it; the gauge makes
-    exposed-vs-overlapped comm a live series next to the byte
-    counters)."""
+def note_exposed_comm(seconds: float,
+                      source: str = "wall_minus_floor") -> None:
+    """Record the EXPOSED (non-overlapped) comm seconds per step, with
+    its provenance as a ``source`` label:
+
+    - ``"anatomy"`` — MEASURED from collective/compute event-interval
+      overlap on the device timelines of a real profiler capture
+      (telemetry/anatomy.py publishes it during instrumented runs;
+      this is the number of record);
+    - ``"wall_minus_floor"`` — benchmarks/bench_comm.py's differential
+      proxy: the leg's wall seconds/step minus the comm-off fp32 floor
+      measured in the same process (includes codec quantize/dequantize
+      compute, so it upper-bounds the measured figure; the divergence
+      between the two series is itself a finding).
+    """
     reg = _registry
     if reg is None:
         return
-    reg.gauge("rlt_comm_exposed_seconds").set(float(seconds))
+    reg.gauge("rlt_comm_exposed_seconds").set(float(seconds),
+                                              source=source)
 
 
 def on_compile() -> None:

@@ -16,7 +16,12 @@ that would otherwise only fail deep inside a live fleet:
 4. profile-controller state machine: pending→active→done, second POST
    rejected while armed;
 5. every new trace-plane instrument name is Prometheus-clean
-   (the PR 2 lint).
+   (the PR 2 lint);
+6. anatomy plane (telemetry/anatomy.py): the parser on the golden
+   synthetic fixture — overlap math (fully-overlapped → ~0 exposed,
+   serialized → exposed ≈ collective), the wall = compute + exposed +
+   host identity, the compact-dict schema — plus the TelemetryConfig
+   anatomy knobs round-tripping through ``worker_env`` / RLT_ANATOMY*.
 """
 
 from __future__ import annotations
@@ -122,13 +127,100 @@ def _check_profile_controller() -> None:
 
 
 def _check_metric_names() -> None:
-    from ray_lightning_tpu.telemetry.metrics import validate_metric_name
+    from ray_lightning_tpu.telemetry.metrics import (
+        CORE_METRICS,
+        validate_metric_name,
+    )
+    anatomy_names = [n for n in CORE_METRICS if n.startswith("rlt_anatomy_")]
+    assert {"rlt_anatomy_compute_seconds", "rlt_anatomy_collective_seconds",
+            "rlt_anatomy_exposed_seconds", "rlt_anatomy_host_seconds",
+            "rlt_anatomy_dcn_seconds", "rlt_anatomy_windows_total"} \
+        <= set(anatomy_names)
     for name in ("rlt_spans_dropped_total",
                  "rlt_serve_queue_wait_seconds",
-                 "rlt_profile_windows_total"):
+                 "rlt_profile_windows_total",
+                 *anatomy_names):
         validate_metric_name(name)
-    print("telemetry selfcheck: trace-plane metric names "
+    print("telemetry selfcheck: trace-plane + anatomy metric names "
           "Prometheus-clean")
+
+
+def _check_anatomy_parser() -> None:
+    """Golden synthetic fixture pins the exposed-comm overlap math and
+    the wall = compute + exposed + host identity."""
+    import tempfile
+    from ray_lightning_tpu.telemetry import anatomy
+
+    # serialized: 10ms compute then 4ms all-reduce -> exposed ≈ collective
+    d = tempfile.mkdtemp(prefix="rlt_sc_anat_")
+    anatomy.write_synthetic_trace(d, ops=[
+        {"name": "fusion.1", "ts": 0, "dur": 10_000},
+        {"name": "all-reduce.1", "ts": 10_000, "dur": 4_000},
+    ], modules=[{"name": "jit_step", "ts": 0, "dur": 14_000}])
+    a = anatomy.parse_trace_anatomy(d, steps=1, ici_size=1,
+                                    multi_process=False)
+    assert abs(a.exposed_s - 0.004) < 1e-9, a.exposed_s
+    assert abs(a.collective_s - 0.004) < 1e-9
+    assert a.collective_by_op == {"all-reduce": 0.004}
+    assert a.collective_by_link == {"ici": 0.004}
+
+    # fully overlapped: the same all-reduce inside the compute span ->
+    # ~0 exposed; group-less on a multi-process mesh charges DCN
+    d = tempfile.mkdtemp(prefix="rlt_sc_anat_")
+    anatomy.write_synthetic_trace(d, ops=[
+        {"name": "fusion.1", "ts": 0, "dur": 10_000},
+        {"name": "all-reduce.1", "ts": 2_000, "dur": 4_000},
+    ])
+    a = anatomy.parse_trace_anatomy(d, steps=1, ici_size=1,
+                                    multi_process=True)
+    assert a.exposed_s == 0.0 and abs(a.collective_s - 0.004) < 1e-12
+    assert a.collective_by_link == {"dcn": 0.004}
+
+    # identity + compact-dict schema (the wire/bench form)
+    assert abs(a.wall_s - (a.compute_s + a.exposed_s + a.host_s)) < 1e-12
+    doc = a.as_dict()
+    assert {"steps", "devices", "wall_s", "compute_s", "collective_s",
+            "exposed_s", "host_s", "collective_by_op",
+            "collective_by_link", "bubble_fraction", "modules",
+            "source"} <= set(doc)
+    assert doc["source"] == "xla-device"
+    print("telemetry selfcheck: anatomy overlap math OK "
+          "(serialized exposed==collective, overlapped exposed==0, "
+          "wall identity holds)")
+
+
+def _check_anatomy_config_roundtrip() -> None:
+    """TelemetryConfig anatomy knobs → worker_env → env resolution."""
+    import os
+    from ray_lightning_tpu.telemetry import TelemetryConfig, anatomy
+
+    cfg = TelemetryConfig(anatomy_every_n_steps=12, anatomy_steps=3)
+    env = cfg.worker_env()
+    assert env == {anatomy.ANATOMY_EVERY_ENV: "12",
+                   anatomy.ANATOMY_STEPS_ENV: "3"}, env
+    saved = {k: os.environ.get(k) for k in
+             (anatomy.ANATOMY_ENV, anatomy.ANATOMY_EVERY_ENV,
+              anatomy.ANATOMY_STEPS_ENV)}
+    try:
+        for k in saved:
+            os.environ.pop(k, None)
+        os.environ.update(env)
+        # a default config in the worker resolves the SAME cadence
+        assert TelemetryConfig().resolved_anatomy() == (12, 3)
+        for k in env:
+            os.environ.pop(k)
+        assert TelemetryConfig().resolved_anatomy()[0] is None
+        os.environ[anatomy.ANATOMY_ENV] = "1"
+        assert TelemetryConfig().resolved_anatomy() == \
+            (anatomy.DEFAULT_EVERY_N, anatomy.DEFAULT_WINDOW)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    print("telemetry selfcheck: anatomy config round-trip via "
+          "worker_env/RLT_ANATOMY* OK")
 
 
 def _main(argv: list) -> int:
@@ -137,6 +229,8 @@ def _main(argv: list) -> int:
     _check_flight_bounded()
     _check_profile_controller()
     _check_metric_names()
+    _check_anatomy_parser()
+    _check_anatomy_config_roundtrip()
     return 0
 
 

@@ -96,6 +96,23 @@ def record_request_span(name: str, t0: float, t1: Optional[float] = None,
         _log.debug("request span dropped", exc_info=True)
 
 
+def _attach_window_anatomy(controller, out: dict) -> None:
+    """Link the parsed per-rank step anatomy (telemetry/anatomy.py)
+    next to a completed window's ``last_dir`` in a controller's status
+    dict.  Parsed once per window dir and cached on the controller —
+    /status polls must not re-read a multi-MB trace each scrape."""
+    last_dir = out.get("last_dir")
+    if not last_dir:
+        return
+    cached = getattr(controller, "_anatomy_cache", None)
+    if cached is None or cached[0] != last_dir:
+        from ray_lightning_tpu.telemetry.anatomy import profile_dir_anatomy
+        cached = (last_dir, profile_dir_anatomy(last_dir))
+        controller._anatomy_cache = cached
+    if cached[1] is not None:
+        out["anatomy"] = cached[1]
+
+
 # -- on-demand profiling: serve plane (plan-broadcast control) -----------
 
 class ServeProfileController:
@@ -170,6 +187,7 @@ class ServeProfileController:
                     out["remaining"] = self._req["remaining"]
             if self.last_dir is not None:
                 out["last_dir"] = self.last_dir
+        _attach_window_anatomy(self, out)
         return out
 
 
@@ -260,6 +278,7 @@ class FileProfileController:
             out["state"] = "done"
             out["ranks_done"] = [fn[:-len(".done")] for fn in done]
             out["last_dir"] = self._last["dir"]
+            _attach_window_anatomy(self, out)
         return out
 
 

@@ -66,6 +66,17 @@ def test_lint_covers_every_registered_name_in_tree():
     assert M.lint_metric_names() == []
 
 
+def test_anatomy_series_covered_by_lint():
+    """Every rlt_anatomy_* series the anatomy controller publishes is a
+    declared CORE metric (so the name lint owns the full surface)."""
+    assert {"rlt_anatomy_compute_seconds",
+            "rlt_anatomy_collective_seconds",
+            "rlt_anatomy_exposed_seconds",
+            "rlt_anatomy_host_seconds",
+            "rlt_anatomy_dcn_seconds",
+            "rlt_anatomy_windows_total"} <= set(M.CORE_METRICS)
+
+
 def test_lint_flags_dirty_registration(tmp_path):
     (tmp_path / "mod.py").write_text(
         'reg.counter("torch_steps")\n')
@@ -160,9 +171,15 @@ def test_dcn_bytes_charged_per_executed_step():
     M.note_step_collectives(ops, dcn_bytes=declared_dcn_bytes(ops, True))
     M.on_step(0.01, k=2, step=2)
     assert reg.counter("rlt_comm_dcn_bytes_total").value() == 40 * 2
+    # the exposed gauge carries its provenance as a source label:
+    # bench's wall-minus-floor proxy by default, the trace-measured
+    # figure when the anatomy plane publishes (telemetry/anatomy.py)
     M.note_exposed_comm(0.012)
-    assert reg.gauge("rlt_comm_exposed_seconds").value() \
-        == pytest.approx(0.012)
+    assert reg.gauge("rlt_comm_exposed_seconds").value(
+        source="wall_minus_floor") == pytest.approx(0.012)
+    M.note_exposed_comm(0.008, source="anatomy")
+    assert reg.gauge("rlt_comm_exposed_seconds").value(
+        source="anatomy") == pytest.approx(0.008)
 
 
 def test_ring_attention_registers_rotation_bytes():

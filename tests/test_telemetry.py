@@ -33,6 +33,8 @@ def _clean_telemetry():
     leak them across tests."""
     yield
     telemetry.disable()
+    telemetry.disable_anatomy()
+    telemetry.disable_metrics()
     telemetry.set_active(None)
 
 
@@ -402,6 +404,229 @@ def test_fit_profile_control_file_round_trip(tmp_path, monkeypatch):
         tracing.reset_profile_tick()
 
 
+# -- anatomy plane (telemetry/anatomy.py) --------------------------------
+
+def test_anatomy_parses_real_capture(tmp_path, monkeypatch):
+    """A REAL profiler capture (via the fit control-file machinery, the
+    same path POST /debug/profile arms) parses into a StepAnatomy whose
+    parts are nonnegative and sum to <= the step wall, and the
+    controller's status links the parsed anatomy next to last_dir."""
+    import jax
+    import jax.numpy as jnp
+    from ray_lightning_tpu.telemetry import anatomy
+
+    control = str(tmp_path / "profile" / "control.json")
+    ctl = tracing.FileProfileController(control)
+    resp = ctl.request(3)
+    monkeypatch.setenv(tracing.PROFILE_CONTROL_ENV, control)
+    monkeypatch.setenv("RLT_PROCESS_ID", "0")
+    tracing.reset_profile_tick()
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.ones((64, 64))
+    f(x).block_until_ready()
+    try:
+        tracing.profile_tick()       # polls the file, starts the trace
+        for _ in range(3):           # real device work INSIDE the window
+            f(x).block_until_ready()
+            tracing.profile_tick()
+    finally:
+        tracing.reset_profile_tick()
+    status = ctl.status()
+    assert status["state"] == "done", status
+
+    a = anatomy.parse_trace_anatomy(os.path.join(resp["dir"], "rank0"))
+    assert a.steps >= 1 and a.devices >= 1
+    assert a.compute_s >= 0 and a.collective_s >= 0
+    assert a.exposed_s >= 0 and a.host_s >= 0
+    # the decomposition identity: parts sum to the step wall (tiny
+    # epsilon: the compact dict rounds to nanoseconds)
+    assert a.compute_s + a.exposed_s + a.host_s <= a.wall_s + 1e-8
+    assert a.compute_s > 0, "no compute measured from a real capture"
+    # the controller's status links the parsed anatomy per rank
+    assert "anatomy" in status, status
+    assert status["anatomy"]["0"]["compute_s"] > 0
+
+
+def test_anatomy_golden_overlap_math(tmp_path):
+    """The golden synthetic fixture pins the exposed-comm interval
+    math: fully-overlapped -> ~0 exposed, serialized -> exposed ≈
+    collective; partial overlap measures exactly the uncovered part."""
+    from ray_lightning_tpu.telemetry import anatomy
+
+    serial = tmp_path / "serial"
+    anatomy.write_synthetic_trace(str(serial), ops=[
+        {"name": "fusion.1", "ts": 0, "dur": 10_000},
+        {"name": "all-reduce.1", "ts": 10_000, "dur": 4_000},
+    ], modules=[{"name": "jit_step", "ts": 0, "dur": 14_000}])
+    a = anatomy.parse_trace_anatomy(str(serial), steps=1, ici_size=1,
+                                    multi_process=False)
+    assert a.exposed_s == pytest.approx(0.004)
+    assert a.collective_s == pytest.approx(0.004)
+    assert a.collective_by_op == {"all-reduce": pytest.approx(0.004)}
+    assert a.collective_by_link == {"ici": pytest.approx(0.004)}
+    assert a.wall_s == pytest.approx(
+        a.compute_s + a.exposed_s + a.host_s)
+
+    overlapped = tmp_path / "overlapped"
+    anatomy.write_synthetic_trace(str(overlapped), ops=[
+        {"name": "fusion.1", "ts": 0, "dur": 10_000},
+        {"name": "all-reduce.1", "ts": 2_000, "dur": 4_000},
+    ])
+    a = anatomy.parse_trace_anatomy(str(overlapped), steps=1, ici_size=1,
+                                    multi_process=True)
+    assert a.exposed_s == 0.0
+    assert a.collective_s == pytest.approx(0.004)
+    # group-less collective on a multi-process mesh charges DCN
+    assert a.collective_by_link == {"dcn": pytest.approx(0.004)}
+
+    partial = tmp_path / "partial"
+    anatomy.write_synthetic_trace(str(partial), ops=[
+        {"name": "fusion.1", "ts": 0, "dur": 10_000},
+        {"name": "all-reduce.1", "ts": 8_000, "dur": 4_000},
+    ])
+    a = anatomy.parse_trace_anatomy(str(partial), steps=1, ici_size=1,
+                                    multi_process=False)
+    assert a.exposed_s == pytest.approx(0.002)   # [10ms, 12ms) uncovered
+
+
+def test_anatomy_replica_groups_decide_link(tmp_path):
+    """A collective event whose args carry the lowered HLO's
+    replica_groups is classified by comm/audit.py's crosses_dcn, not
+    the topology fallback: groups inside one 2-rank host block -> ici
+    even on a multi-process mesh."""
+    from ray_lightning_tpu.telemetry import anatomy
+
+    d = tmp_path / "groups"
+    anatomy.write_synthetic_trace(str(d), ops=[
+        {"name": "fusion.1", "ts": 0, "dur": 5_000},
+        {"name": "all-reduce.2", "ts": 5_000, "dur": 1_000,
+         "args": {"long_name": "all-reduce(f32[8]), "
+                               "replica_groups={{0,1},{2,3}}"}},
+        {"name": "all-reduce.3", "ts": 6_000, "dur": 2_000,
+         "args": {"long_name": "all-reduce(f32[8]), "
+                               "replica_groups={{0,2},{1,3}}"}},
+    ])
+    a = anatomy.parse_trace_anatomy(str(d), steps=1, ici_size=2,
+                                    multi_process=True)
+    assert a.collective_by_link["ici"] == pytest.approx(0.001)
+    assert a.collective_by_link["dcn"] == pytest.approx(0.002)
+
+
+def test_anatomy_ingest_status_flight_and_export(tmp_path):
+    """Anatomy wire items land on the aggregator: /status gains the
+    per-rank section with straggler skew, the export summary carries
+    it, and a flight dump names where the rank's device time went."""
+    from ray_lightning_tpu.telemetry import anatomy
+    from ray_lightning_tpu.telemetry import exporter as _exporter
+
+    agg = TelemetryAggregator(str(tmp_path))
+    a0 = {"steps": 2, "devices": 1, "wall_s": 0.010, "compute_s": 0.006,
+          "collective_s": 0.004, "exposed_s": 0.003, "host_s": 0.001,
+          "collective_by_op": {"all-reduce": 0.004},
+          "collective_by_link": {"dcn": 0.004},
+          "bubble_fraction": 0.1, "modules": {}, "source": "cpu-host"}
+    a1 = dict(a0, wall_s=0.020)      # rank 1 is a 2x straggler
+    assert agg.maybe_ingest(anatomy.anatomy_item(0, a0))
+    assert agg.maybe_ingest(anatomy.anatomy_item(1, a1))
+    stats = agg.anatomy_stats()
+    assert set(stats["per_rank"]) == {"0", "1"}
+    assert stats["windows"] == 2
+    assert stats["straggler_skew"] == pytest.approx(2.0)
+    doc = _exporter.render_status(agg)
+    assert doc["anatomy"]["per_rank"]["1"]["wall_s"] == 0.020
+    paths = agg.export()
+    assert paths["summary"]["anatomy"]["straggler_skew"] == \
+        pytest.approx(2.0)
+    dump = agg.flight.dump(1, "unit-test cause")
+    assert json.load(open(dump))["anatomy"]["wall_s"] == 0.020
+
+
+def test_anatomy_controller_cadence_and_gauges(tmp_path):
+    """The auto-capture controller: every_n dispatches arm a window
+    through the WorkerProfiler machinery, the rank parses its OWN
+    capture, ships only the compact dict, and publishes the
+    rlt_anatomy_* gauges + the measured exposed-comm source label."""
+    import jax
+    import jax.numpy as jnp
+    from ray_lightning_tpu.telemetry import anatomy
+    from ray_lightning_tpu.telemetry import metrics as _metrics
+
+    reg = _metrics.enable_metrics(rank=0, sink=None, pump=False)
+    shipped = []
+    ctl = telemetry.enable_anatomy(rank=0, every_n=2, window=2,
+                                   sink=shipped.append)
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.ones((32, 32))
+    f(x).block_until_ready()
+    for _ in range(6):               # ticks 2..4 arm + close one window
+        telemetry.anatomy_tick()
+        f(x).block_until_ready()
+    assert ctl.windows >= 1, "no anatomy window completed"
+    assert shipped, "anatomy dict was not shipped"
+    item = shipped[0]
+    assert item["kind"] == "anatomy" and item["rank"] == 0
+    a = item["anatomy"]
+    assert a["compute_s"] > 0
+    assert a["compute_s"] + a["exposed_s"] + a["host_s"] \
+        <= a["wall_s"] + 1e-8
+    # teardown abandons the in-flight second window and removes its
+    # capture dir — only compact dicts ever leave the rank
+    inflight = ctl._dir
+    telemetry.disable_anatomy()
+    assert ctl._dir is None
+    assert inflight is None or not os.path.isdir(inflight)
+    assert reg.gauge("rlt_anatomy_compute_seconds").value() == \
+        pytest.approx(a["compute_s"])
+    assert reg.counter("rlt_anatomy_windows_total").value() >= 1
+    # measured exposed feeds the comm gauge under the anatomy source
+    assert reg.gauge("rlt_comm_exposed_seconds").value(
+        source="anatomy") == pytest.approx(a["exposed_s"])
+
+
+def test_anatomy_config_env_roundtrip(monkeypatch):
+    from ray_lightning_tpu.telemetry import TelemetryConfig, anatomy
+
+    for var in (anatomy.ANATOMY_ENV, anatomy.ANATOMY_EVERY_ENV,
+                anatomy.ANATOMY_STEPS_ENV):
+        monkeypatch.delenv(var, raising=False)
+    assert TelemetryConfig().resolved_anatomy()[0] is None
+    assert TelemetryConfig().worker_env() == {}
+    cfg = TelemetryConfig(anatomy_every_n_steps=10, anatomy_steps=3)
+    assert cfg.resolved_anatomy() == (10, 3)
+    env = cfg.worker_env()
+    assert env == {anatomy.ANATOMY_EVERY_ENV: "10",
+                   anatomy.ANATOMY_STEPS_ENV: "3"}
+    # a worker's default config resolves the same cadence from the env
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    assert TelemetryConfig().resolved_anatomy() == (10, 3)
+    monkeypatch.delenv(anatomy.ANATOMY_EVERY_ENV)
+    monkeypatch.delenv(anatomy.ANATOMY_STEPS_ENV)
+    monkeypatch.setenv(anatomy.ANATOMY_ENV, "1")
+    assert TelemetryConfig().resolved_anatomy() == \
+        (anatomy.DEFAULT_EVERY_N, anatomy.DEFAULT_WINDOW)
+
+
+def test_local_fit_with_anatomy_armed(tmp_path, seed):
+    """An in-process fit with the cadence armed lands a measured
+    per-rank anatomy in the exported summary."""
+    trainer = Trainer(max_epochs=1, limit_train_batches=8,
+                      limit_val_batches=0, num_sanity_val_steps=0,
+                      enable_checkpointing=False, seed=0,
+                      log_every_n_steps=1, default_root_dir=str(tmp_path),
+                      telemetry={"anatomy_every_n_steps": 2,
+                                 "anatomy_steps": 2})
+    trainer.fit(BoringModel())
+    summary = trainer._telemetry_paths["summary"]
+    assert "anatomy" in summary, "no anatomy in export summary"
+    a = summary["anatomy"]["per_rank"]["0"]
+    assert a["compute_s"] >= 0 and a["exposed_s"] >= 0
+    assert a["compute_s"] + a["exposed_s"] + a["host_s"] \
+        <= a["wall_s"] + 1e-8
+    # controller torn down with the rest of telemetry
+    assert telemetry.get_anatomy_controller() is None
+
+
 # -- trainer integration -------------------------------------------------
 
 def test_local_fit_exports_trace(tmp_path, seed):
@@ -474,8 +699,24 @@ def test_e2e_two_workers_spans_from_both_ranks(tmp_path, seed):
                       enable_checkpointing=False, seed=0,
                       log_every_n_steps=1, plugins=[cpu_plugin(2)],
                       default_root_dir=str(tmp_path),
-                      telemetry={"heartbeat_interval": 0.5})
+                      telemetry={"heartbeat_interval": 0.5,
+                                 "anatomy_every_n_steps": 2,
+                                 "anatomy_steps": 2})
     trainer.fit(BoringModel())
+
+    # anatomy acceptance: with the cadence armed, BOTH ranks parsed a
+    # real capture locally and the driver's summary carries per-rank
+    # measured step anatomy (the same dict /status serves live)
+    anatomy = trainer._telemetry_paths["summary"].get("anatomy")
+    assert anatomy and set(anatomy["per_rank"]) == {"0", "1"}, anatomy
+    for rank, a in anatomy["per_rank"].items():
+        assert a["compute_s"] >= 0 and a["exposed_s"] >= 0
+        assert a["compute_s"] + a["exposed_s"] + a["host_s"] \
+            <= a["wall_s"] + 1e-8, (rank, a)
+        # the 2-process data axis all-reduce is measured and, being
+        # group-less across hosts, charged to the DCN link
+        assert "all-reduce" in a["collective_by_op"], (rank, a)
+        assert a["collective_by_link"].get("dcn", 0) > 0, (rank, a)
 
     paths = trainer._telemetry_paths
     assert paths is not None
