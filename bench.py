@@ -123,6 +123,18 @@ def main(argv=None) -> int:
         if comm_results:
             results.extend(comm_results)
 
+    if os.environ.get("RLT_FLEET_AB") == "1":
+        # fleet-plane traffic replay (benchmarks/bench_fleet.py): record
+        # a multi-tenant trace, replay at 1x/2x/4x against 1 vs 2
+        # replicas plus an autoscaling 1→3 leg — one `fleet` JSON line
+        # with tokens/s + TTFT per multiplier, autoscale events, the
+        # prefix-reuse ratio and the greedy-parity verdict.  Joins the
+        # --compare ledger via fleet.tokens_per_sec / fleet.ttft_p99_ms.
+        from benchmarks.bench_fleet import run_fleet_ab
+        fleet_results = run_fleet_ab(metric + "_fleet")
+        if fleet_results:
+            results.extend(fleet_results)
+
     if args.out:
         with open(args.out, "w") as f:
             for r in results:

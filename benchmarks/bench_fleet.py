@@ -1,0 +1,313 @@
+"""Fleet-plane benchmark: traffic-record-and-replay against 1 vs N
+serve replicas — the "heavy traffic" leg made measurable.
+
+The harness RECORDS a request trace (a multi-tenant session: arrival
+offsets, tenant, prompt tokens with a shared system prompt inside each
+tenant group, per-request token budget) to a JSON file, then REPLAYS it
+at 1x/2x/4x time compression:
+
+- **1x / 2x, 1 vs 2 replicas** — a plain :class:`Server` (the
+  single-fleet reference; also the greedy-parity oracle) vs a
+  :class:`FleetServer` with 2 replicas and paged-KV prefix reuse.  The
+  acceptance bar: 2 replicas sustain strictly higher tokens/s than 1
+  at the 2x multiplier.
+- **4x, autoscaling 1→3 replicas** — the burst drives queue depth past
+  the grow threshold (at least one grow event), and the idle tail
+  after the burst drives occupancy to zero (at least one shrink, the
+  drained replica's requests completing elsewhere).
+- **prefix reuse** — each tenant group shares a system prompt, so the
+  fleet's ``prefill tokens computed vs requested`` ratio must come out
+  nonzero.
+- **parity** — every routed request's tokens are compared with the
+  single-``Server`` reference; bf16 near-tie flips fall back to the
+  teacher-forced tolerance bar (tests/test_serve.py's 2e-2).
+
+Emits ONE ``fleet`` JSON line with tokens/s + TTFT p50/p99 per
+multiplier, the replicas A/B, autoscale events (with actuation
+seconds), the prefix-reuse ratio and the parity verdict.  Wired into
+``bench.py`` as the ``RLT_FLEET_AB=1`` leg and into the perf ledger
+(``bench.py --compare``) through the ``fleet.tokens_per_sec`` /
+``fleet.ttft_p99_ms`` bands.
+
+    python -m benchmarks.bench_fleet [--requests N] [--trace PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+#: serving geometry for the CPU-proxy run (tiny GPT, block 32)
+BUCKETS = (16, 32)
+SLOTS = 4
+PAGE_SIZE = 8
+MAX_NEW = 14
+
+
+def record_trace(path: str, requests: int = 64, seed: int = 0,
+                 duration_s: float = 0.8) -> list:
+    """Record a multi-tenant request trace to ``path``.
+
+    Three tenant groups; the tenants inside a group share a 2-page
+    system prompt (the prefix-reuse mix), each request appending its
+    own suffix.  Arrival offsets spread over ``duration_s`` with a
+    front-loaded burst so compressed replays genuinely queue.
+    """
+    rng = np.random.default_rng(seed)
+    groups = {
+        "alice": np.asarray(rng.integers(1, 100, size=2 * PAGE_SIZE)),
+        "bob": np.asarray(rng.integers(1, 100, size=2 * PAGE_SIZE)),
+        "carol": None,    # no shared prompt: the cold-path control
+    }
+    tenants = list(groups)
+    trace = []
+    for i in range(requests):
+        tenant = tenants[i % len(tenants)]
+        shared = groups[tenant]
+        suffix = rng.integers(1, 100, size=int(rng.integers(3, 9)))
+        prompt = suffix if shared is None \
+            else np.concatenate([shared, suffix])
+        trace.append({
+            # front-loaded: 70% of arrivals in the first half
+            "at": round(float(rng.beta(1.2, 2.0)) * duration_s, 4),
+            "tenant": tenant,
+            "prompt": [int(t) for t in prompt],
+            "max_new": int(MAX_NEW),
+        })
+    trace.sort(key=lambda r: r["at"])
+    with open(path, "w") as f:
+        json.dump({"version": 1, "requests": trace}, f)
+    return trace
+
+
+def load_trace(path: str) -> list:
+    with open(path) as f:
+        return json.load(f)["requests"]
+
+
+def replay(endpoint, trace: list, multiplier: float,
+           timeout: float = 600.0) -> dict:
+    """Replay the trace at ``multiplier``x time compression against any
+    ``submit``-surface endpoint (Server or FleetServer); returns the
+    measured leg."""
+    t0 = time.monotonic()
+    handles = []
+    for rec in trace:
+        due = t0 + rec["at"] / multiplier
+        delay = due - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        handles.append(endpoint.submit(
+            np.asarray(rec["prompt"], np.int32), tenant=rec["tenant"],
+            max_new_tokens=rec["max_new"]))
+    outs = [h.result(timeout=timeout) for h in handles]
+    wall = time.monotonic() - t0
+    ttfts = np.asarray([h.ttft_s for h in handles
+                        if h.ttft_s is not None]) * 1e3
+    tokens = int(sum(len(o) for o in outs))
+    return {
+        "tokens_per_sec": round(tokens / wall, 2),
+        "total_tokens": tokens,
+        "wall_s": round(wall, 3),
+        "requests": len(handles),
+        "ttft_p50_ms": round(float(np.percentile(ttfts, 50)), 2)
+        if len(ttfts) else None,
+        "ttft_p99_ms": round(float(np.percentile(ttfts, 99)), 2)
+        if len(ttfts) else None,
+        "outputs": [o.tolist() for o in outs],
+    }
+
+
+def check_parity(module, engine_params_ref, trace: list, legs: dict
+                 ) -> dict:
+    """Every routed request greedy-parity-equal to the single-Server
+    reference: exact token match, with the teacher-forced 2e-2
+    tolerance bar (tests/test_serve.py) deciding bf16 near-tie flips."""
+    model = module.configure_decode_model()
+    params = engine_params_ref
+    ref_outputs = legs["reference"]["outputs"]
+    checked = flipped = bad = 0
+    for leg_name, leg in legs.items():
+        if leg_name == "reference":
+            continue
+        for rec, got, want in zip(trace, leg["outputs"], ref_outputs):
+            checked += 1
+            if got == want:
+                continue
+            flipped += 1
+            seq = [int(t) for t in rec["prompt"]]
+            for tok in got:
+                logits = np.asarray(model.apply(
+                    {"params": params},
+                    np.asarray([seq], np.int32), True))[0, -1]
+                best = int(np.argmax(logits))
+                if tok != best and logits[tok] < logits[best] - 2e-2:
+                    bad += 1
+                    break
+                seq.append(int(tok))
+    return {"checked": checked, "exact": checked - flipped,
+            "tolerance_flips": flipped - bad, "mismatched": bad,
+            "ok": bad == 0}
+
+
+def run_fleet_ab(metric: str, requests: int = 64,
+                 trace_path: "str | None" = None) -> "list[dict]":
+    """The RLT_FLEET_AB=1 bench leg; returns the emitted records."""
+    from ray_lightning_tpu.models.gpt import GPTConfig, GPTLightningModule
+    from ray_lightning_tpu.serve import Server
+    from ray_lightning_tpu.serve.fleet import FleetServer
+
+    cfg = GPTConfig(vocab_size=128, block_size=32, n_layer=2, n_head=2,
+                    n_embd=32, remat=False)
+    num_workers = int(os.environ.get("RLT_FLEET_WORKERS", "1"))
+    platform = os.environ.get("RLT_FLEET_PLATFORM", "cpu")
+    root = os.environ.get("RLT_FLEET_DIR") or tempfile.mkdtemp(
+        prefix="rlt_bench_fleet_")
+    cache = os.path.join(root, "compile_cache")
+
+    if trace_path and os.path.exists(trace_path):
+        trace = load_trace(trace_path)
+    else:
+        trace_path = trace_path or os.path.join(root, "trace.json")
+        trace = record_trace(trace_path, requests=requests)
+
+    server_kw = dict(
+        num_workers=num_workers, platform=platform, buckets=BUCKETS,
+        max_batch_slots=SLOTS, max_new_tokens=MAX_NEW,
+        compile_cache=cache, telemetry=False)
+
+    legs: dict = {}
+    # -- single Server: the reference fleet AND the parity oracle ------
+    module = GPTLightningModule(cfg)
+    server = Server(module, default_root_dir=os.path.join(root, "ref"),
+                    paged=False, **server_kw).start()
+    try:
+        legs["reference"] = replay(server, trace, 1.0)
+        legs["single_2x"] = replay(server, trace, 2.0)
+    finally:
+        server.shutdown()
+
+    # -- 2 fixed replicas, paged prefix reuse --------------------------
+    fleet2 = FleetServer(
+        GPTLightningModule(cfg), replicas=2, autoscale=False,
+        paged={"page_size": PAGE_SIZE},
+        default_root_dir=os.path.join(root, "fleet2"),
+        **server_kw).start()
+    try:
+        legs["fleet2_1x"] = replay(fleet2, trace, 1.0)
+        legs["fleet2_2x"] = replay(fleet2, trace, 2.0)
+        fleet2_pages = fleet2.pages_stats()
+        fleet2_status = fleet2.status()["fleet"]
+    finally:
+        fleet2.shutdown()
+
+    # -- autoscaling fleet under the 4x burst --------------------------
+    auto = FleetServer(
+        GPTLightningModule(cfg), replicas=1,
+        fleet={"min_replicas": 1, "max_replicas": 3,
+               "grow_queue_depth": 2.0, "patience_ticks": 2,
+               "cooldown_s": 1.0, "tick_interval_s": 0.1,
+               "shrink_occupancy": 0.25},
+        paged={"page_size": PAGE_SIZE},
+        default_root_dir=os.path.join(root, "auto"),
+        **server_kw).start()
+    try:
+        legs["auto_4x"] = replay(auto, trace, 4.0)
+        # idle tail: empty queue + zero occupancy drives the shrink
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            st = auto.autoscaler.stats()
+            if st["shrinks"] >= 1 and not st["actuating"]:
+                break
+            time.sleep(0.2)
+        autoscale = auto.autoscaler.stats()
+        auto_status = auto.status()["fleet"]
+        auto_pages = auto.pages_stats()
+    finally:
+        auto.shutdown()
+
+    # -- parity: every routed request vs the single-Server reference ---
+    import jax
+    eng = None
+    try:
+        from ray_lightning_tpu.parallel.strategy import (
+            DataParallelStrategy)
+        from ray_lightning_tpu.serve.engine import ServeEngine
+        eng = ServeEngine(module, DataParallelStrategy(),
+                          buckets=BUCKETS, slots=SLOTS,
+                          max_seq_len=cfg.block_size, seed=0).setup()
+        ref_params = jax.device_get(eng.params)
+    finally:
+        del eng
+    parity = check_parity(module, ref_params, trace, legs)
+
+    headline = legs["fleet2_2x"]
+    fleet_doc = {
+        "trace": {"path": trace_path, "requests": len(trace),
+                  "tenants": len({r['tenant'] for r in trace})},
+        "workers_per_replica": num_workers,
+        "platform": platform,
+        "slots": SLOTS,
+        "page_size": PAGE_SIZE,
+        "tokens_per_sec": headline["tokens_per_sec"],
+        "ttft_p99_ms": headline["ttft_p99_ms"],
+        "multipliers": {
+            "1x": {"single": _slim(legs["reference"]),
+                   "fleet2": _slim(legs["fleet2_1x"])},
+            "2x": {"single": _slim(legs["single_2x"]),
+                   "fleet2": _slim(legs["fleet2_2x"])},
+            "4x": {"autoscale": _slim(legs["auto_4x"])},
+        },
+        "autoscale": {
+            "events": autoscale["events"],
+            "grows": autoscale["grows"],
+            "shrinks": autoscale["shrinks"],
+        },
+        "prefix_reuse": fleet2_pages,
+        "prefix_reuse_auto": auto_pages,
+        "failovers": (fleet2_status["failovers"]
+                      + auto_status["failovers"]),
+        "requests_lost": fleet2_status["failed"] + auto_status["failed"],
+        "parity": parity,
+    }
+    record = {"metric": metric, "value": headline["tokens_per_sec"],
+              "unit": "tokens/s", "fleet": fleet_doc}
+    print(json.dumps(record), flush=True)
+
+    # the acceptance bars, enforced where the bench runs
+    assert legs["fleet2_2x"]["tokens_per_sec"] \
+        > legs["single_2x"]["tokens_per_sec"], (
+        "2 replicas did not beat 1 at the 2x replay",
+        legs["fleet2_2x"]["tokens_per_sec"],
+        legs["single_2x"]["tokens_per_sec"])
+    assert autoscale["grows"] >= 1, autoscale
+    assert autoscale["shrinks"] >= 1, autoscale
+    assert fleet_doc["prefix_reuse"]["prefix_reuse_ratio"] > 0, \
+        fleet_doc["prefix_reuse"]
+    assert fleet_doc["requests_lost"] == 0, fleet_doc["failovers"]
+    assert parity["ok"], parity
+    return [record]
+
+
+def _slim(leg: dict) -> dict:
+    return {k: v for k, v in leg.items() if k != "outputs"}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--requests", type=int, default=64)
+    parser.add_argument("--trace", default=None,
+                        help="replay this recorded trace JSON instead "
+                        "of recording a fresh one")
+    args = parser.parse_args()
+    run_fleet_ab("fleet_serve", requests=args.requests,
+                 trace_path=args.trace)
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,13 @@ figure                          worse    band
                                         10%) + ``min_exposed_s``
                                         absolute floor, so sub-ms CPU
                                         noise never trips the gate
+``serve.tokens_per_sec`` /
+``fleet.tokens_per_sec``        lower   ``serve_band`` (default 15% —
+                                        CPU-proxy serving wall clock
+                                        is noisier than steps/sec)
+``serve.ttft_p99_ms`` /
+``fleet.ttft_p99_ms``           higher  ``serve_band`` +
+                                        ``min_ttft_ms`` floor
 ==============================  ======  ==============================
 
 Improvements are reported too (the ledger is a trajectory, not just an
@@ -43,8 +50,14 @@ from typing import Any, Iterable
 #: default relative bands (fraction of the previous value)
 STEP_BAND = 0.05
 EXPOSED_BAND = 0.10
+#: serve-side figures (bench_serve.py `serve`, bench_fleet.py `fleet`):
+#: wall-clock tokens/s + latency tails on the CPU proxy swing more than
+#: compiled-step device time, so the band is wider
+SERVE_BAND = 0.15
 #: absolute floor under which exposed-comm drift is noise, not signal
 MIN_EXPOSED_S = 1e-4
+#: absolute TTFT floor: p99 jitter below this is scheduler noise
+MIN_TTFT_MS = 2.0
 
 
 def _iter_records(obj: Any) -> Iterable[dict]:
@@ -102,7 +115,9 @@ def _exposed_of(rec: dict) -> "float | None":
 
 def compare(prev: Any, curr: Any, *, step_band: float = STEP_BAND,
             exposed_band: float = EXPOSED_BAND,
-            min_exposed_s: float = MIN_EXPOSED_S) -> dict:
+            serve_band: float = SERVE_BAND,
+            min_exposed_s: float = MIN_EXPOSED_S,
+            min_ttft_ms: float = MIN_TTFT_MS) -> dict:
     """Compare two rounds; the returned report's ``ok`` is the gate.
 
     ``prev``/``curr``: anything :func:`load_records` accepts.
@@ -139,6 +154,20 @@ def compare(prev: Any, curr: Any, *, step_band: float = STEP_BAND,
         if pe is not None and ce is not None:
             check(metric, "exposed_comm_seconds", pe, ce, "higher",
                   exposed_band, floor=min_exposed_s)
+        # serve-side fields (bench_serve.py `serve` dict, bench_fleet.py
+        # `fleet` dict): throughput lower-is-worse, TTFT tail
+        # higher-is-worse — the serving legs join the same gate as the
+        # fit-side steps/sec instead of regressing silently
+        for key in ("serve", "fleet"):
+            ps, cs = p.get(key), c.get(key)
+            if not (isinstance(ps, dict) and isinstance(cs, dict)):
+                continue
+            check(metric, f"{key}.tokens_per_sec",
+                  ps.get("tokens_per_sec"), cs.get("tokens_per_sec"),
+                  "lower", serve_band)
+            check(metric, f"{key}.ttft_p99_ms", ps.get("ttft_p99_ms"),
+                  cs.get("ttft_p99_ms"), "higher", serve_band,
+                  floor=min_ttft_ms)
     report = {
         "metric": "perf_ledger",
         "compared": compared,
@@ -147,7 +176,8 @@ def compare(prev: Any, curr: Any, *, step_band: float = STEP_BAND,
         "only_prev": sorted(set(prev_by) - set(curr_by)),
         "only_curr": sorted(set(curr_by) - set(prev_by)),
         "bands": {"step": step_band, "exposed": exposed_band,
-                  "min_exposed_s": min_exposed_s},
+                  "serve": serve_band, "min_exposed_s": min_exposed_s,
+                  "min_ttft_ms": min_ttft_ms},
         "ok": not regressions,
     }
     return report
@@ -167,9 +197,13 @@ def main(argv: list) -> int:
     parser.add_argument("--exposed-band", type=float, default=EXPOSED_BAND,
                         help="relative band for exposed-comm seconds "
                         f"(default {EXPOSED_BAND})")
+    parser.add_argument("--serve-band", type=float, default=SERVE_BAND,
+                        help="relative band for serve/fleet tokens-per-"
+                        f"sec and TTFT p99 (default {SERVE_BAND})")
     args = parser.parse_args(argv)
     report = compare(args.prev, args.curr, step_band=args.step_band,
-                     exposed_band=args.exposed_band)
+                     exposed_band=args.exposed_band,
+                     serve_band=args.serve_band)
     print(json.dumps(report))
     return 0 if report["ok"] else 1
 

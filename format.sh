@@ -55,6 +55,14 @@ if [[ "$CHECK" == 1 ]]; then
     # (ray_lightning_tpu/serve/selfcheck.py)
     python -c 'import sys; from ray_lightning_tpu.serve.selfcheck \
         import _main; sys.exit(_main([]))'
+    # fleet-plane selfcheck: FleetConfig/PageConfig validation +
+    # RLT_FLEET*/RLT_SERVE_PAGED* env round-trip, page free-list
+    # accounting, prefix-hash round-trip (collision-verified), the
+    # autoscaler patience/cooldown state machine, router least-loaded/
+    # sticky/quota invariants, rlt_fleet_* metric names
+    # (ray_lightning_tpu/serve/fleet/selfcheck.py)
+    python -c 'import sys; from ray_lightning_tpu.serve.fleet.selfcheck \
+        import _main; sys.exit(_main([]))'
     # elastic-plane selfcheck: ElasticConfig validation + RLT_ELASTIC*
     # env round-trip, fault-spec parsing, elastic metric names, and the
     # residual re-bucket's injected-error invariant on a CPU array

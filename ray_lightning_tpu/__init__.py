@@ -63,6 +63,9 @@ def __getattr__(name):
     if name == "Server":
         from ray_lightning_tpu.serve import Server
         return Server
+    if name == "FleetServer":
+        from ray_lightning_tpu.serve.fleet import FleetServer
+        return FleetServer
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
@@ -86,5 +89,6 @@ __all__ = [
     "ElasticConfig",
     "PlanConfig",
     "Server",
+    "FleetServer",
     "__version__",
 ]

@@ -293,6 +293,70 @@ def build_decode_step(module) -> Callable:
     return step_fn
 
 
+def build_kv_copy() -> Callable:
+    """Paged-KV page copy program (serve/fleet/pages.py prefix reuse).
+
+    ``(k_caches, v_caches, src, dst, length) -> (k', v')`` copies cache
+    rows ``[0, length)`` from slot ``src`` into slot ``dst`` across
+    every layer — the device half of a prefix-cache hit: the matched
+    pages move as one masked row-copy instead of being recomputed by a
+    prefill.  ``src``/``dst``/``length`` are traced int32 scalars, so
+    ONE compiled program serves every (donor, destination, match
+    length) triple.  Sound because a cache row is a pure per-(token,
+    position) value (ops/attention.py MultiHeadAttention decode path):
+    identical prefixes have identical rows wherever they were computed.
+    """
+
+    def copy_fn(k_caches, v_caches, src, dst, length):
+        L = k_caches.shape[2]
+        mask = (jnp.arange(L) < length)[None, None, :, None, None]
+
+        def one(c):
+            src_rows = jax.lax.dynamic_slice_in_dim(c, src, 1, axis=1)
+            dst_rows = jax.lax.dynamic_slice_in_dim(c, dst, 1, axis=1)
+            merged = jnp.where(mask, src_rows, dst_rows)
+            return jax.lax.dynamic_update_slice_in_dim(c, merged, dst,
+                                                       axis=1)
+
+        return one(k_caches), one(v_caches)
+
+    return copy_fn
+
+
+def build_suffix_step(module) -> Callable:
+    """Single-slot suffix-prefill program (the compute leg of prefix
+    reuse, serve/fleet/pages.py).
+
+    ``(params, k_caches, v_caches, token, pos, slot) ->
+    (k', v', next_token)``: advances ONE slot one token — the model's
+    decode forward on a 1-slot batch sliced out of the cache, written
+    back in place.  After a prefix-cache hit copies the matched pages
+    (:func:`build_kv_copy`), the unmatched suffix is teacher-forced
+    through this program one token at a time; only the suffix is ever
+    computed, which is the measured ``prefill tokens computed vs
+    requested`` savings.  Unlike the batched decode program this writes
+    NOTHING outside ``slot`` — no dummy writes to neighbors — so it can
+    run mid-step without the serve plan's dispatch-order contract.
+    """
+    module.setup_model()
+    model = module.configure_decode_model()
+
+    def step_fn(params, k_caches, v_caches, token, pos, slot):
+        k1 = jax.lax.dynamic_slice_in_dim(k_caches, slot, 1, axis=1)
+        v1 = jax.lax.dynamic_slice_in_dim(v_caches, slot, 1, axis=1)
+        logits, nk, nv = model.apply(
+            {"params": params}, token[None], pos[None], k1, v1,
+            method="decode")
+        k_caches = jax.lax.dynamic_update_slice_in_dim(k_caches, nk,
+                                                       slot, axis=1)
+        v_caches = jax.lax.dynamic_update_slice_in_dim(v_caches, nv,
+                                                       slot, axis=1)
+        nxt = jnp.argmax(logits[0], axis=-1).astype(token.dtype)
+        return k_caches, v_caches, nxt
+
+    return step_fn
+
+
 def build_eval_step(module, stage: str) -> Callable:
     """(state, batch) -> logged metrics dict (pure, no state mutation)."""
     step = {"validate": module.validation_step,

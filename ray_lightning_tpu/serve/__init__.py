@@ -12,6 +12,8 @@ Layout:
 - ``worker.py``    — the persistent serve actor (cluster backends)
 - ``server.py``    — the public :class:`Server` endpoint
 - ``selfcheck.py`` — dependency-light invariants for ``format.sh --check``
+- ``fleet/``       — the fleet plane: :class:`FleetServer` router over
+  N replicas, signal-driven autoscaling, paged KV with prefix reuse
 """
 
 from ray_lightning_tpu.serve.buckets import (  # noqa: F401
@@ -30,9 +32,21 @@ from ray_lightning_tpu.serve.scheduler import (  # noqa: F401
 )
 from ray_lightning_tpu.serve.server import Server, ServeSpec  # noqa: F401
 
+
+def __getattr__(name):
+    # the fleet plane imports lazily: Server alone must not pay for it
+    if name in ("FleetServer", "FleetConfig", "PageConfig"):
+        from ray_lightning_tpu.serve import fleet
+        return getattr(fleet, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "Server",
     "ServeSpec",
+    "FleetServer",
+    "FleetConfig",
+    "PageConfig",
     "Scheduler",
     "ServeRequest",
     "KVCacheSpec",

@@ -38,7 +38,9 @@ import numpy as np
 from ray_lightning_tpu.compile import AotPrecompiler
 from ray_lightning_tpu.core.steps import (
     build_decode_step,
+    build_kv_copy,
     build_prefill_step,
+    build_suffix_step,
     kv_layer_pairs,
 )
 from ray_lightning_tpu.serve.kvcache import KVCacheSpec
@@ -52,7 +54,7 @@ class ServeEngine:
 
     def __init__(self, module, strategy, buckets: Sequence[int],
                  slots: int, max_seq_len: int, seed: int = 0,
-                 weights: Optional[dict] = None):
+                 weights: Optional[dict] = None, paged: Any = None):
         self.module = module
         self.strategy = strategy
         self.buckets = tuple(buckets)
@@ -60,12 +62,19 @@ class ServeEngine:
         self.max_seq_len = int(max_seq_len)
         self.seed = int(seed)
         self._weights = weights
+        #: PageConfig (serve/fleet/pages.py) — when enabled the engine
+        #: additionally builds the page-copy + single-slot suffix
+        #: programs that make prefix-cache hits executable
+        self.paged = paged if paged is not None and paged.enabled \
+            else None
         self.trace_counts: dict[str, int] = {}
         self.kv_spec: Optional[KVCacheSpec] = None
         self.params = None
         self._mesh = None
         self._prefills: dict[int, Any] = {}
         self._decode = None
+        self._kv_copy = None
+        self._suffix = None
         self._kv_init = None
         self._k = None
         self._v = None
@@ -162,6 +171,18 @@ class ServeEngine:
             self._prefills[b] = jit_step(
                 f"prefill_{b}", build_prefill_step(module, b), 3)
         self._decode = jit_step("decode", build_decode_step(module), 2)
+        if self.paged is not None:
+            # paged-KV programs (serve/fleet/pages.py): a masked page
+            # copy for prefix-cache hits + the single-slot suffix step
+            # that computes only the unmatched tail of a prompt
+            self._suffix = jit_step("suffix", build_suffix_step(module),
+                                    3)
+            ckw: dict = {"donate_argnums": (0, 1)}
+            if multi:
+                ckw["in_shardings"] = (kv_sh, kv_sh, rep, rep, rep)
+                ckw["out_shardings"] = (kv_sh, kv_sh)
+            self._kv_copy = jax.jit(
+                self._counted("kv_copy", build_kv_copy()), **ckw)
 
         # AOT avals must describe the params AS SERVED (post
         # param_dtype cast / restore), not the fp32 init avals — a
@@ -195,6 +216,12 @@ class ServeEngine:
         pre.submit("decode", self._decode,
                    (abstract_params, kv_aval, kv_aval,
                     i32(self.slots), i32(self.slots)))
+        if self.paged is not None:
+            pre.submit("suffix", self._suffix,
+                       (abstract_params, kv_aval, kv_aval,
+                        i32(), i32(), i32()))
+            pre.submit("kv_copy", self._kv_copy,
+                       (kv_aval, kv_aval, i32(), i32(), i32()))
         pre.barrier()
 
         # scratch warmup: the warmed cache state is garbage, so re-init
@@ -207,6 +234,11 @@ class ServeEngine:
                                np.int32(0), np.int32(1))
         zeros = np.zeros((self.slots,), np.int32)
         k, v, toks = self._decode(self.params, k, v, zeros, zeros)
+        if self.paged is not None:
+            k, v = self._kv_copy(k, v, np.int32(0),
+                                 np.int32(self.slots - 1), np.int32(1))
+            k, v, toks = self._suffix(self.params, k, v, np.int32(0),
+                                      np.int32(0), np.int32(0))
         jax.block_until_ready(toks)
         del k, v
         self._k, self._v = self._kv_init()
@@ -243,6 +275,36 @@ class ServeEngine:
                      time.monotonic() - t0)
         return out
 
+    def prefill_reused(self, slot: int, src_slot: int,
+                       tokens: np.ndarray, length: int,
+                       matched: int) -> int:
+        """Prefix-cache-hit insertion (serve/fleet/pages.py): copy the
+        ``matched`` donor rows device-side, then teacher-force ONLY the
+        unmatched suffix through the single-slot suffix program.  The
+        last suffix step's argmax is the request's first generated
+        token — the same greedy contract as :meth:`prefill`, at
+        ``length - matched`` computed tokens instead of ``length``."""
+        if self._kv_copy is None:
+            raise RuntimeError("engine built without paged=; no reuse "
+                               "programs")
+        t0 = time.monotonic()
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        self._k, self._v = self._kv_copy(
+            self._k, self._v, np.int32(src_slot), np.int32(slot),
+            np.int32(matched))
+        # a full-prompt match still replays the final prompt token (a
+        # same-value rewrite) to read its logits for the first token
+        out = None
+        for pos in range(min(int(matched), int(length) - 1), int(length)):
+            self._k, self._v, out = self._suffix(
+                self.params, self._k, self._v, np.int32(toks[pos]),
+                np.int32(pos), np.int32(slot))
+        import jax
+        first = int(np.asarray(jax.device_get(out)))
+        self._charge("rlt_serve_prefill_seconds_total",
+                     time.monotonic() - t0)
+        return first
+
     def decode(self, tokens: np.ndarray,
                positions: np.ndarray) -> np.ndarray:
         """One continuous-batching step: every slot advances a token."""
@@ -276,7 +338,9 @@ class ServeEngine:
             # decode loop never re-traced while serving
             "retraces": {name: n - warm.get(name, 0)
                          for name, n in self.trace_counts.items()},
-            "programs": 1 + 1 + len(self._prefills),   # kv_init+decode+
+            # kv_init + decode + prefills (+ paged copy/suffix pair)
+            "programs": 1 + 1 + len(self._prefills)
+            + (2 if self.paged is not None else 0),
             "compile_cache": {
                 "active": compile_cache.active_dir() is not None,
                 "hits": s.hits,

@@ -1,0 +1,54 @@
+"""Fleet serving plane: a front-door router over N serve replicas
+(ROADMAP item 2 — "millions of users need many fleets").
+
+Layout:
+
+- ``router.py``    — :class:`FleetServer`: the public front door —
+  least-loaded + tenant-sticky routing, fleet-wide quotas, failover
+  with flight-recorder-linked reports
+- ``replica.py``   — :class:`FleetReplica`: lifecycle + load probes
+  around one unmodified :class:`~ray_lightning_tpu.serve.server.Server`
+- ``autoscale.py`` — :class:`Autoscaler`: queue-depth / TTFT-p99-driven
+  grow & shrink between ``min_replicas``/``max_replicas`` with
+  patience + cooldown debouncing
+- ``pages.py``     — paged KV accounting + the prefix-hash index behind
+  "shared system prompts prefill once per replica"
+- ``config.py``    — :class:`FleetConfig` (+ the RLT_FLEET* env
+  round-trip)
+- ``selfcheck.py`` — dependency-light invariants for
+  ``format.sh --check``
+"""
+
+from ray_lightning_tpu.serve.fleet.autoscale import (  # noqa: F401
+    Autoscaler,
+)
+from ray_lightning_tpu.serve.fleet.config import FleetConfig  # noqa: F401
+from ray_lightning_tpu.serve.fleet.pages import (  # noqa: F401
+    PageConfig,
+    PagedKV,
+    PagePool,
+    PrefixIndex,
+)
+from ray_lightning_tpu.serve.fleet.replica import (  # noqa: F401
+    FleetReplica,
+)
+from ray_lightning_tpu.serve.fleet.router import (  # noqa: F401
+    FleetReplicaLost,
+    FleetRequest,
+    FleetServer,
+    pick_replica,
+)
+
+__all__ = [
+    "FleetServer",
+    "FleetRequest",
+    "FleetReplica",
+    "FleetReplicaLost",
+    "FleetConfig",
+    "Autoscaler",
+    "PageConfig",
+    "PagedKV",
+    "PagePool",
+    "PrefixIndex",
+    "pick_replica",
+]

@@ -1,0 +1,814 @@
+"""The fleet front door: :class:`FleetServer` — N serve replicas behind
+one ``submit/generate/drain/shutdown`` surface.
+
+One :class:`~ray_lightning_tpu.serve.server.Server` is exactly one SPMD
+fleet; heavy traffic needs many.  ``FleetServer(module, replicas=N)``
+holds N independent replicas (each an unmodified ``Server`` placed
+through the existing cluster backends) and adds the three fleet
+behaviors on the driver:
+
+- **Routing** — least-loaded by (active slots, queue depth), with
+  tenant stickiness as the tiebreak inside ``sticky_slack``: a tenant's
+  requests keep landing on the replica that already holds its prefix
+  pages (serve/fleet/pages.py KV affinity), but never at the price of
+  real load imbalance.  Per-tenant quotas are enforced FLEET-WIDE on
+  dispatched in-flight requests (the per-replica schedulers run
+  unquoted); a tenant at quota parks in the fleet queue without
+  head-of-line-blocking other tenants.
+
+- **Failover** — a replica whose serve pump dies has already failed its
+  admitted requests (cause + per-rank flight-recorder dumps in
+  ``Server.failure_report``); the router re-dispatches every
+  queued-but-unprefilled request to survivors (safe: nothing was
+  computed, generation is deterministic) and fails only the truly lost
+  in-flight ones with a :class:`FleetReplicaLost` that links the flight
+  paths.  The fleet then grows a replacement back toward
+  ``min_replicas``.
+
+- **Autoscaling** — the pump feeds queue-depth / TTFT-p99 signals (the
+  trace plane's numbers) to the :class:`~ray_lightning_tpu.serve.fleet.
+  autoscale.Autoscaler`; grow spawns a replica in the background
+  (PR 7's grow-to-continue headroom, serve-side), shrink drains one
+  gracefully — withdrawn queued requests complete elsewhere, in-flight
+  ones finish locally, then the replica shuts down (the serve analog of
+  shrink-to-continue).  Decisions, cooldowns and per-event actuation
+  seconds land on ``/status`` and as ``rlt_fleet_*`` gauges/counters.
+
+::
+
+    fleet = FleetServer(module, replicas=2, num_workers=1,
+                        platform="cpu", fleet={"max_replicas": 4},
+                        telemetry={"metrics_port": 0}).start()
+    req = fleet.submit(prompt_tokens, tenant="alice")
+    tokens = req.result(timeout=60)
+    fleet.shutdown()
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ray_lightning_tpu.serve.fleet.autoscale import Autoscaler
+from ray_lightning_tpu.serve.fleet.config import FleetConfig
+from ray_lightning_tpu.serve.fleet.pages import PageConfig
+from ray_lightning_tpu.serve.fleet.replica import FleetReplica
+from ray_lightning_tpu.telemetry import metrics as _metrics
+
+_log = logging.getLogger(__name__)
+
+
+def pick_replica(rows: "list[dict]", sticky_rid: Optional[int] = None,
+                 sticky_slack: int = 1) -> Optional[int]:
+    """Routing policy (pure — fleet/selfcheck.py drives it directly).
+
+    ``rows``: one ``{"rid", "active", "queued", "slots"}`` per routable
+    replica.  Least-loaded wins: fewest active slots, then shortest
+    queue, then lowest id (deterministic).  The tenant's sticky replica
+    overrides the winner only while its load is within ``sticky_slack``
+    of the winner on BOTH axes — KV affinity must never hide a hot
+    replica.
+    """
+    if not rows:
+        return None
+    best = min(rows, key=lambda r: (r["active"], r["queued"], r["rid"]))
+    if sticky_rid is not None and sticky_rid != best["rid"]:
+        for r in rows:
+            if r["rid"] == sticky_rid \
+                    and r["active"] <= best["active"] + sticky_slack \
+                    and r["queued"] <= best["queued"] + sticky_slack:
+                return r["rid"]
+    return best["rid"]
+
+
+class FleetReplicaLost(RuntimeError):
+    """An in-flight request died with its replica; carries the links to
+    the per-rank flight-recorder dumps (the failover report)."""
+
+    def __init__(self, message: str, flight_paths: Optional[dict] = None):
+        super().__init__(message)
+        self.flight_paths = dict(flight_paths or {})
+
+
+class FleetRequest:
+    """Driver-side handle on one fleet request.  Mirrors
+    :class:`~ray_lightning_tpu.serve.scheduler.ServeRequest`'s surface
+    (``done()`` / ``result(timeout)``) but survives replica failover:
+    the inner per-replica request may be replaced any number of times
+    before the fleet-level outcome settles."""
+
+    def __init__(self, fid: int, prompt: np.ndarray, tenant: str,
+                 max_new_tokens: Optional[int]):
+        self.id = fid
+        self.prompt = prompt
+        self.tenant = tenant
+        self.max_new_tokens = max_new_tokens
+        #: current per-replica request (None while parked in the fleet
+        #: queue) and the replica it was dispatched to
+        self.inner = None
+        self.replica: Optional[int] = None
+        self.requeues = 0
+        self.t_submit = time.monotonic()
+        self.t_done: Optional[float] = None
+        #: fleet-level TTFT: submit-at-the-front-door to first token,
+        #: fleet queueing included (the autoscaler's grow signal)
+        self.ttft_s: Optional[float] = None
+        self.tpot_s: Optional[float] = None
+        self.error: Optional[BaseException] = None
+        self._tokens: Optional[np.ndarray] = None
+        self._event = threading.Event()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"fleet request {self.id} not complete after {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self._tokens
+
+
+class FleetServer:
+    """Front-door router over N serve replicas with signal-driven
+    autoscaling (module docstring)."""
+
+    def __init__(
+        self,
+        module,
+        *,
+        replicas: Optional[int] = None,
+        fleet: Any = None,
+        autoscale: bool = True,
+        tenant_quotas: "dict[str, int] | int | None" = None,
+        paged: Any = True,
+        telemetry: Any = None,
+        default_root_dir: Optional[str] = None,
+        replica_factory: Optional[Callable[[int], Any]] = None,
+        **server_kwargs,
+    ):
+        from ray_lightning_tpu.telemetry import TelemetryConfig
+        cfg = FleetConfig.resolve(fleet)
+        initial = int(replicas) if replicas is not None \
+            else cfg.min_replicas
+        if initial < 1:
+            raise ValueError("replicas must be >= 1")
+        if not autoscale:
+            cfg = dataclasses.replace(cfg, min_replicas=initial,
+                                      max_replicas=initial)
+        else:
+            if initial > cfg.max_replicas:
+                cfg = dataclasses.replace(cfg, max_replicas=initial)
+            if initial < cfg.min_replicas:
+                cfg = dataclasses.replace(cfg, min_replicas=initial)
+        self.cfg = cfg
+        self.initial_replicas = initial
+        self.module = module
+        self.paged = PageConfig.resolve(paged)
+        self._default_quota: Optional[int] = (
+            int(tenant_quotas) if isinstance(tenant_quotas, int) else None)
+        self._quotas: dict[str, int] = (
+            dict(tenant_quotas) if isinstance(tenant_quotas, dict) else {})
+        self.telemetry = TelemetryConfig.resolve(telemetry)
+        self.default_root_dir = default_root_dir or os.path.join(
+            os.getcwd(), "rlt_fleet")
+        server_kwargs.pop("tenant_quotas", None)   # fleet-enforced
+        self._server_kwargs = server_kwargs
+        self._factory = replica_factory or self._default_factory
+        self.autoscaler = Autoscaler(cfg)
+        self._replicas: dict[int, FleetReplica] = {}
+        self._rid = 0
+        self._pending: deque[FleetRequest] = deque()
+        self._inflight: dict[int, FleetRequest] = {}
+        self._tenant_inflight: dict[str, int] = {}
+        self._sticky: dict[str, int] = {}
+        self._fid = 0
+        self._lock = threading.RLock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._pump: Optional[threading.Thread] = None
+        self._scale_threads: list[threading.Thread] = []
+        self._agg = None
+        self._metrics_server = None
+        self._last_tick = 0.0
+        self._ttfts: deque[float] = deque(maxlen=128)
+        self._draining = False
+        self._started = False
+        #: failover log: replica, cause, flight paths, requeued/failed
+        self.failovers: list[dict] = []
+        #: prefix-reuse counters folded in from removed replicas, so a
+        #: shrink doesn't erase the fleet's reuse evidence
+        self._retired_pages = {"prefill_tokens_requested": 0,
+                               "prefill_tokens_computed": 0,
+                               "prefix_hits": 0, "reused_prefills": 0}
+        self.completed = 0
+        self.failed = 0
+        self.requeued = 0
+
+    # -- construction ------------------------------------------------------
+
+    def _default_factory(self, rid: int):
+        """An unmodified :class:`Server` per replica: same module, same
+        config, its own worker actors via the cluster backends.  The
+        fleet's env knobs (RLT_FLEET*, RLT_SERVE_PAGED*) round-trip
+        into every replica's worker actors."""
+        import dataclasses as _dc
+
+        from ray_lightning_tpu.serve.server import Server
+        kw = dict(self._server_kwargs)
+        worker_env = {**self.cfg.worker_env(),
+                      **kw.pop("worker_env", {})}
+        # replicas carry their own aggregator (heartbeats + flight
+        # recorder for THEIR workers) but never the driver metrics
+        # registry or HTTP endpoint — those are fleet-level singletons
+        rep_telemetry = None
+        if self.telemetry.enabled:
+            rep_telemetry = _dc.replace(self.telemetry, metrics=False,
+                                        metrics_port=None)
+        return Server(
+            self.module,
+            tenant_quotas=None,
+            telemetry=rep_telemetry,
+            paged=self.paged,
+            default_root_dir=os.path.join(self.default_root_dir,
+                                          f"replica_{rid}"),
+            worker_env=worker_env,
+            **kw)
+
+    def _new_replica(self) -> FleetReplica:
+        with self._lock:
+            rid = self._rid
+            self._rid += 1
+            rep = FleetReplica(rid, self._factory(rid))
+            self._replicas[rid] = rep
+        return rep
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FleetServer":
+        """Spawn the initial replicas (concurrently — each is its own
+        actor fleet), start the router pump.  Blocking; returns self."""
+        if self._started:
+            return self
+        self._start_telemetry()
+        reps = [self._new_replica() for _ in range(self.initial_replicas)]
+        errors: list[BaseException] = []
+
+        def boot(rep):
+            try:
+                rep.start()
+            except BaseException as e:   # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=boot, args=(rep,),
+                                    name=f"rlt-fleet-boot-{rep.id}",
+                                    daemon=True) for rep in reps]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            for rep in reps:
+                try:
+                    rep.shutdown(graceful=False)
+                except Exception:
+                    pass
+            self._stop_telemetry()
+            raise errors[0]
+        self._started = True
+        self._pump = threading.Thread(target=self._pump_loop, daemon=True,
+                                      name="rlt-fleet-router")
+        self._pump.start()
+        _log.info("fleet ready: %d replica(s), autoscale [%d, %d]",
+                  len(reps), self.cfg.min_replicas, self.cfg.max_replicas)
+        return self
+
+    def _start_telemetry(self) -> None:
+        cfg = self.telemetry
+        if not (cfg.enabled and cfg.metrics):
+            return
+        from ray_lightning_tpu import telemetry
+        from ray_lightning_tpu.telemetry import exporter as _exporter
+        agg = telemetry.TelemetryAggregator(
+            cfg.resolve_dir(self.default_root_dir),
+            heartbeat_timeout=cfg.heartbeat_timeout,
+            hard_timeout=cfg.hard_timeout,
+            flight_capacity=cfg.flight_capacity)
+        self._agg = agg
+        # ONE driver registry for the whole fleet: the router's
+        # rlt_fleet_* gauges/counters and every replica scheduler's
+        # rlt_serve_* instruments flush into the same exposition
+        telemetry.enable_metrics(rank=-1, sink=agg.ingest_metrics,
+                                 interval=cfg.metrics_interval)
+        self._metrics_server = _exporter.start_metrics_server(
+            agg, cfg, status_extra=self.status)
+
+    def _stop_telemetry(self) -> None:
+        if self._agg is None:
+            return
+        from ray_lightning_tpu import telemetry
+        telemetry.flush_metrics()
+        telemetry.disable_metrics()
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+        self._agg.export()
+        self._agg = None
+
+    @property
+    def metrics_url(self) -> Optional[str]:
+        return self._metrics_server.url \
+            if self._metrics_server is not None else None
+
+    # -- request surface ---------------------------------------------------
+
+    def submit(self, prompt, tenant: str = "default",
+               max_new_tokens: Optional[int] = None) -> FleetRequest:
+        """Enqueue a prompt at the front door; the router dispatches it
+        to the best replica (possibly after a failover or a grow)."""
+        if not self._started:
+            raise RuntimeError("FleetServer.start() first")
+        if self._draining:
+            raise RuntimeError("fleet is draining; no new requests")
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        with self._lock:
+            fr = FleetRequest(self._fid, prompt, tenant, max_new_tokens)
+            self._fid += 1
+            self._pending.append(fr)
+        self._wake.set()
+        return fr
+
+    def generate(self, prompt, tenant: str = "default",
+                 max_new_tokens: Optional[int] = None,
+                 timeout: Optional[float] = 300.0) -> np.ndarray:
+        """Blocking submit-and-wait."""
+        return self.submit(prompt, tenant=tenant,
+                           max_new_tokens=max_new_tokens).result(timeout)
+
+    # -- the router pump ---------------------------------------------------
+
+    def _pump_loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(0.01)
+            self._wake.clear()
+            try:
+                self._poll_completions()
+                self._scan_failures()
+                self._dispatch_pending()
+                self._tick_autoscaler()
+            except Exception:
+                _log.error("fleet router pump error", exc_info=True)
+                time.sleep(0.05)
+
+    def _routable(self) -> "list[FleetReplica]":
+        return [r for r in self._replicas.values() if r.routable]
+
+    def _quota_of(self, tenant: str) -> Optional[int]:
+        return self._quotas.get(tenant, self._default_quota)
+
+    def _poll_completions(self) -> None:
+        with self._lock:
+            inflight = list(self._inflight.values())
+        for fr in inflight:
+            inner = fr.inner
+            if inner is None or not inner.done():
+                continue
+            if inner.error is None:
+                self._finish_ok(fr)
+            else:
+                rep = self._replicas.get(fr.replica)
+                if rep is not None and rep.failed:
+                    continue   # the failover scan routes this one
+                self._finish_failed(fr, inner.error)
+
+    def _finish_ok(self, fr: FleetRequest) -> None:
+        inner = fr.inner
+        fr._tokens = np.asarray(inner.generated, dtype=np.int32)
+        fr.t_done = time.monotonic()
+        if inner.t_first is not None:
+            fr.ttft_s = inner.t_first - fr.t_submit
+            self._ttfts.append(fr.ttft_s)
+        fr.tpot_s = inner.tpot_s
+        with self._lock:
+            self._inflight.pop(fr.id, None)
+            self._tenant_inflight[fr.tenant] = max(
+                0, self._tenant_inflight.get(fr.tenant, 1) - 1)
+            self.completed += 1
+        fr._event.set()
+        self._count("rlt_fleet_requests_total", 1, status="ok",
+                    tenant=fr.tenant)
+
+    def _finish_failed(self, fr: FleetRequest,
+                       error: BaseException) -> None:
+        fr.error = error
+        fr.t_done = time.monotonic()
+        with self._lock:
+            self._inflight.pop(fr.id, None)
+            self._tenant_inflight[fr.tenant] = max(
+                0, self._tenant_inflight.get(fr.tenant, 1) - 1)
+            self.failed += 1
+        fr._event.set()
+        self._count("rlt_fleet_requests_total", 1, status="failed",
+                    tenant=fr.tenant)
+
+    def _requeue(self, fr: FleetRequest) -> None:
+        with self._lock:
+            self._inflight.pop(fr.id, None)
+            self._tenant_inflight[fr.tenant] = max(
+                0, self._tenant_inflight.get(fr.tenant, 1) - 1)
+            fr.inner = None
+            fr.replica = None
+            fr.requeues += 1
+            self._pending.appendleft(fr)
+            self.requeued += 1
+        self._count("rlt_fleet_requests_total", 1, status="requeued",
+                    tenant=fr.tenant)
+
+    def _scan_failures(self) -> None:
+        for rep in list(self._replicas.values()):
+            if rep.failed and rep.state != "dead":
+                self._handle_failover(rep)
+
+    def _handle_failover(self, rep: FleetReplica) -> None:
+        """A replica's serve pump died mid-serve.  Its scheduler has
+        already failed every admitted request (with flight dumps);
+        queued-but-unprefilled ones are re-dispatched to survivors —
+        nothing was computed for them, and greedy generation is
+        deterministic, so a replay is the same answer."""
+        rep.mark_dead()
+        error = rep.server._error
+        report = getattr(rep.server, "failure_report", None) or {}
+        flight_paths = report.get("flight_paths", {})
+        requeued = failed = 0
+        with self._lock:
+            mine = [fr for fr in self._inflight.values()
+                    if fr.replica == rep.id]
+        for fr in mine:
+            inner = fr.inner
+            if inner is not None and inner.t_admit is None:
+                self._requeue(fr)
+                requeued += 1
+            else:
+                lost = FleetReplicaLost(
+                    f"replica {rep.id} lost request in flight: "
+                    f"{error!r} (flight dumps: "
+                    f"{sorted(flight_paths.values())})",
+                    flight_paths=flight_paths)
+                lost.__cause__ = error
+                self._finish_failed(fr, lost)
+                failed += 1
+        event = {"replica": rep.id, "cause": repr(error),
+                 "flight_paths": dict(flight_paths),
+                 "requeued": requeued, "failed": failed,
+                 "at": time.time()}
+        self.failovers.append(event)
+        self._count("rlt_fleet_failover_total", 1)
+        _log.error("fleet failover: replica %d dead (%r); %d requeued, "
+                   "%d lost; flight dumps: %s", rep.id, error, requeued,
+                   failed, sorted(flight_paths.values()))
+        self._reap_async(rep)
+        with self._lock:
+            capacity = sum(1 for r in self._replicas.values()
+                           if r.state in ("starting", "serving"))
+        if capacity < self.cfg.min_replicas:
+            self._spawn_async("failover replacement", autoscaled=False)
+        self._wake.set()
+
+    def _fold_pages(self, rep: FleetReplica) -> None:
+        """Preserve a departing replica's prefix-reuse counters."""
+        pages = getattr(rep.server.scheduler, "pages", None)
+        if pages is None:
+            return
+        st = pages.stats()
+        with self._lock:
+            for key in self._retired_pages:
+                self._retired_pages[key] += st[key]
+
+    def _reap_async(self, rep: FleetReplica) -> None:
+        def reap():
+            try:
+                rep.shutdown(graceful=False)
+            except Exception:
+                pass
+            self._fold_pages(rep)
+            with self._lock:
+                self._replicas.pop(rep.id, None)
+        t = threading.Thread(target=reap, daemon=True,
+                             name=f"rlt-fleet-reap-{rep.id}")
+        t.start()
+        self._scale_threads.append(t)
+
+    def _dispatch_pending(self) -> None:
+        with self._lock:
+            if not self._pending:
+                return
+            routable = self._routable()
+            if not routable:
+                return
+            rows = {rep.id: rep.load_row() for rep in routable}
+            reps = {rep.id: rep for rep in routable}
+            for fr in list(self._pending):
+                quota = self._quota_of(fr.tenant)
+                if quota is not None and \
+                        self._tenant_inflight.get(fr.tenant, 0) >= quota:
+                    continue   # tenant at fleet-wide quota; others pass
+                rid = pick_replica(list(rows.values()),
+                                   self._sticky.get(fr.tenant),
+                                   self.cfg.sticky_slack)
+                if rid is None:
+                    break
+                rep = reps[rid]
+                try:
+                    inner = rep.server.submit(
+                        fr.prompt, tenant=fr.tenant,
+                        max_new_tokens=fr.max_new_tokens)
+                except Exception:
+                    # replica refused (failed/draining between probe
+                    # and submit); the failure scan sorts it out
+                    rows.pop(rid, None)
+                    reps.pop(rid, None)
+                    if not rows:
+                        break
+                    continue
+                self._pending.remove(fr)
+                fr.inner = inner
+                fr.replica = rid
+                self._inflight[fr.id] = fr
+                self._tenant_inflight[fr.tenant] = \
+                    self._tenant_inflight.get(fr.tenant, 0) + 1
+                self._sticky[fr.tenant] = rid
+                rows[rid]["queued"] += 1   # count our own dispatches
+
+    # -- autoscaling -------------------------------------------------------
+
+    def signals(self) -> dict:
+        """The autoscaler's inputs — the same queue-depth and TTFT
+        numbers the trace plane exports per tenant, aggregated
+        fleet-wide."""
+        with self._lock:
+            reps = [r for r in self._replicas.values()
+                    if r.state in ("starting", "serving")]
+            routable = [r for r in reps if r.routable]
+            queued = len(self._pending) + sum(r.queued for r in routable)
+            active = sum(r.active for r in routable)
+            slots = sum(r.slots for r in routable)
+            ttfts = list(self._ttfts)
+        ttft_p99 = (float(np.percentile(np.asarray(ttfts), 99)) * 1e3
+                    if ttfts else None)
+        return {"replicas": len(reps), "queued": queued,
+                "active": active, "slots_total": max(1, slots),
+                "ttft_p99_ms": ttft_p99}
+
+    def _tick_autoscaler(self) -> None:
+        now = time.monotonic()
+        if now - self._last_tick < self.cfg.tick_interval_s:
+            return
+        self._last_tick = now
+        sig = self.signals()
+        self._gauge("rlt_fleet_replicas_total", sig["replicas"])
+        self._gauge("rlt_fleet_queue_depth_total", sig["queued"])
+        self._gauge("rlt_fleet_active_slots_total", sig["active"])
+        if self._draining:
+            return
+        decision = self.autoscaler.tick(sig)
+        if decision is None:
+            return
+        if decision["action"] == "grow":
+            self._spawn_async(decision["reason"], autoscaled=True)
+        else:
+            self._shrink_async(decision["reason"])
+
+    def _spawn_async(self, reason: str, autoscaled: bool) -> None:
+        def grow():
+            t0 = time.monotonic()
+            rep = self._new_replica()
+            ok = True
+            try:
+                rep.start()
+                _log.info("fleet grow: replica %d serving (%s)",
+                          rep.id, reason)
+            except Exception:
+                ok = False
+                _log.error("fleet grow failed", exc_info=True)
+                with self._lock:
+                    self._replicas.pop(rep.id, None)
+            seconds = time.monotonic() - t0
+            if autoscaled:
+                self.autoscaler.note_actuated(seconds, ok)
+            self._count("rlt_fleet_grow_total", 1,
+                        outcome="ok" if ok else "error")
+            self._count("rlt_fleet_scale_seconds_total", seconds,
+                        action="grow")
+            self._wake.set()
+        t = threading.Thread(target=grow, daemon=True,
+                             name="rlt-fleet-grow")
+        t.start()
+        self._scale_threads.append(t)
+
+    def _shrink_async(self, reason: str) -> None:
+        with self._lock:
+            routable = self._routable()
+            if len(routable) <= self.cfg.min_replicas:
+                self.autoscaler.note_actuated(0.0, False)
+                return
+            # least-loaded first; ties drain the NEWEST replica — the
+            # oldest holds the warmest prefix-donor population
+            rep = min(routable,
+                      key=lambda r: (r.active + r.queued, -r.id))
+            rep.mark_draining()
+
+        def shrink():
+            t0 = time.monotonic()
+            ok = True
+            # withdraw the not-yet-admitted requests; they complete on
+            # a surviving replica (nothing computed for them yet)
+            withdrawn = rep.server.scheduler.withdraw_queued()
+            withdrawn_ids = {id(r) for r in withdrawn}
+            with self._lock:
+                mine = [fr for fr in self._inflight.values()
+                        if fr.replica == rep.id and fr.inner is not None
+                        and id(fr.inner) in withdrawn_ids]
+            for fr in mine:
+                self._requeue(fr)
+            self._wake.set()
+            deadline = time.monotonic() + 300
+            while not rep.idle():
+                if rep.failed or time.monotonic() > deadline:
+                    ok = False
+                    break
+                time.sleep(0.02)
+            if ok:
+                try:
+                    rep.shutdown(graceful=True)
+                except Exception:
+                    ok = False
+                    _log.warning("fleet shrink: replica %d shutdown "
+                                 "failed", rep.id, exc_info=True)
+                self._fold_pages(rep)
+                with self._lock:
+                    self._replicas.pop(rep.id, None)
+                _log.info("fleet shrink: replica %d drained and "
+                          "stopped (%s)", rep.id, reason)
+            seconds = time.monotonic() - t0
+            self.autoscaler.note_actuated(seconds, ok)
+            self._count("rlt_fleet_shrink_total", 1,
+                        outcome="ok" if ok else "error")
+            self._count("rlt_fleet_scale_seconds_total", seconds,
+                        action="shrink")
+            self._wake.set()
+        t = threading.Thread(target=shrink, daemon=True,
+                             name=f"rlt-fleet-shrink-{rep.id}")
+        t.start()
+        self._scale_threads.append(t)
+
+    # -- drain / shutdown --------------------------------------------------
+
+    def drain(self, timeout: Optional[float] = 300.0) -> None:
+        """Stop admitting; wait for every pending and in-flight request
+        to settle (completed, failed, or failed over and completed)."""
+        self._draining = True
+        self._wake.set()
+        deadline = time.monotonic() + (timeout or 0)
+        while True:
+            with self._lock:
+                if not self._pending and not self._inflight:
+                    return
+            if timeout is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"fleet drain incomplete after "
+                                   f"{timeout}s")
+            self._wake.set()
+            time.sleep(0.02)
+
+    def shutdown(self, graceful: bool = True) -> None:
+        """Drain (when graceful), stop the router, tear down every
+        replica and the fleet telemetry."""
+        if graceful and self._started:
+            try:
+                self.drain()
+            except TimeoutError:
+                _log.warning("fleet drain timed out; shutting down "
+                             "anyway")
+        self._stop.set()
+        self._wake.set()
+        if self._pump is not None and self._pump.is_alive():
+            self._pump.join(10)
+        for t in self._scale_threads:
+            t.join(30)
+        reps = list(self._replicas.values())
+
+        def down(rep):
+            try:
+                rep.shutdown(graceful=graceful)
+            except Exception:
+                _log.warning("replica %d shutdown failed", rep.id,
+                             exc_info=True)
+
+        threads = [threading.Thread(target=down, args=(rep,), daemon=True)
+                   for rep in reps]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        self._replicas.clear()
+        self._stop_telemetry()
+        self._started = False
+
+    def __enter__(self) -> "FleetServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(graceful=exc[0] is None)
+
+    # -- evidence ----------------------------------------------------------
+
+    def status(self) -> dict:
+        """The fleet block of ``/status`` (exporter ``status_extra``)
+        and the bench's evidence surface."""
+        with self._lock:
+            replicas = {str(rid): rep.status()
+                        for rid, rep in sorted(self._replicas.items())}
+            pending = len(self._pending)
+            inflight = len(self._inflight)
+            sticky = dict(self._sticky)
+        pages = self.pages_stats()
+        doc = {
+            "fleet": {
+                "replicas": replicas,
+                "pending": pending,
+                "inflight": inflight,
+                "completed": self.completed,
+                "failed": self.failed,
+                "requeued": self.requeued,
+                "sticky": sticky,
+                "autoscale": self.autoscaler.stats(),
+                "failovers": [dict(e) for e in self.failovers],
+                "bounds": {"min": self.cfg.min_replicas,
+                           "max": self.cfg.max_replicas},
+            }
+        }
+        if pages:
+            doc["fleet"]["pages"] = pages
+        return doc
+
+    def pages_stats(self) -> Optional[dict]:
+        """Fleet-aggregated prefix-reuse numbers (sums the replicas'
+        PagedKV stats; ratio recomputed over the sums)."""
+        if not self.paged.enabled:
+            return None
+        with self._lock:
+            reps = list(self._replicas.values())
+            retired = dict(self._retired_pages)
+        requested = retired["prefill_tokens_requested"]
+        computed = retired["prefill_tokens_computed"]
+        hits = retired["prefix_hits"]
+        reused = retired["reused_prefills"]
+        for rep in reps:
+            pages = getattr(rep.server.scheduler, "pages", None)
+            if pages is None:
+                continue
+            st = pages.stats()
+            requested += st["prefill_tokens_requested"]
+            computed += st["prefill_tokens_computed"]
+            hits += st["prefix_hits"]
+            reused += st["reused_prefills"]
+        return {
+            "page_size": self.paged.page_size,
+            "prefill_tokens_requested": requested,
+            "prefill_tokens_computed": computed,
+            "prefix_hits": hits,
+            "reused_prefills": reused,
+            "prefix_reuse_ratio": round(1.0 - computed / requested, 4)
+            if requested else 0.0,
+        }
+
+    def stats(self) -> dict:
+        return {**self.status(),
+                "signals": self.signals()}
+
+    # -- metrics plumbing (no-ops when the metrics plane is off) -----------
+
+    @staticmethod
+    def _count(name: str, value: float, **labels: Any) -> None:
+        reg = _metrics.get_registry()
+        if reg is not None:
+            reg.counter(name).inc(value, **labels)
+
+    @staticmethod
+    def _gauge(name: str, value: float) -> None:
+        reg = _metrics.get_registry()
+        if reg is not None:
+            reg.gauge(name).set(value)
+
+
+__all__ = ["FleetServer", "FleetRequest", "FleetReplicaLost",
+           "pick_replica"]

@@ -1,0 +1,208 @@
+"""Fleet-plane selfcheck for ``format.sh --check`` (CI gate).
+
+Same contract as the serve/comm/elastic selfchecks: cheap,
+deterministic, no pytest, no jax backend — validates the invariants
+that would otherwise only fail deep inside a live fleet:
+
+1. ``FleetConfig`` / ``PageConfig`` validation + the RLT_FLEET* /
+   RLT_SERVE_PAGED* env round-trip (replica actors must inherit the
+   fleet config under both cluster backends);
+2. page free-list accounting: ``free + allocated == total`` through
+   alloc / lazy-growth / donor-retention / eviction;
+3. prefix-hash round-trip: longest page-aligned match, exact-token
+   verification (a forged hash collision must NOT donate), drop;
+4. the autoscaler cooldown state machine: patience debounce, cooldown
+   after actuation, min/max bounds, grow-beats-shrink;
+5. router policy invariants: least-loaded pick, tenant stickiness
+   within slack only, and fleet-wide quota conservation under a
+   simulated dispatch loop;
+6. every ``rlt_fleet_*`` metric name is Prometheus-clean (the PR 2
+   lint).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _check_config_roundtrip() -> None:
+    from ray_lightning_tpu.serve.fleet.config import FleetConfig
+    from ray_lightning_tpu.serve.fleet.pages import PageConfig
+
+    cfg = FleetConfig(min_replicas=2, max_replicas=5,
+                      grow_queue_depth=3.5, grow_ttft_p99_ms=250.0,
+                      shrink_occupancy=0.2, patience_ticks=3,
+                      cooldown_s=7.5, tick_interval_s=0.25,
+                      sticky_slack=2)
+    saved = {k: os.environ.pop(k) for k in list(os.environ)
+             if k.startswith(("RLT_FLEET", "RLT_SERVE_PAGE"))}
+    try:
+        os.environ.update(cfg.worker_env())
+        assert FleetConfig.resolve(None) == cfg, FleetConfig.resolve(None)
+        for k in cfg.worker_env():
+            del os.environ[k]
+        pc = PageConfig(enabled=True, page_size=32)
+        os.environ.update(pc.worker_env())
+        assert PageConfig.resolve(None) == pc
+        for k in pc.worker_env():
+            del os.environ[k]
+        assert PageConfig.resolve(None) == PageConfig(enabled=False)
+        assert not PageConfig(enabled=False).worker_env()
+    finally:
+        for k in list(os.environ):
+            if k.startswith(("RLT_FLEET", "RLT_SERVE_PAGE")):
+                del os.environ[k]
+        os.environ.update(saved)
+    for bad in (dict(min_replicas=0), dict(max_replicas=0),
+                dict(patience_ticks=0), dict(tick_interval_s=0)):
+        try:
+            FleetConfig(**bad)
+        except ValueError:
+            pass
+        else:
+            raise AssertionError(f"expected ValueError for {bad}")
+    print("fleet selfcheck: FleetConfig/PageConfig env round-trip OK")
+
+
+def _check_page_pool() -> None:
+    from ray_lightning_tpu.serve.fleet.pages import PagePool
+
+    pool = PagePool(slots=4, max_seq_len=32, page_size=8)
+    assert pool.total_pages == 16 and pool.free == 16
+    pool.note_written(0, 9)          # 2 pages
+    pool.note_written(0, 5)          # never shrinks below high water
+    assert pool.held(0) == 2
+    pool.note_written(1, 32)         # the whole slot
+    pool.check()
+    assert pool.free == 16 - 2 - 4
+    freed = pool.shrink_to(1, 16)    # donor keeps its 2 prefix pages
+    assert freed == 2 and pool.held(1) == 2
+    pool.check()
+    assert pool.release(1) == 2 and pool.release(1) == 0
+    pool.check()
+    assert pool.free == 14
+    print("fleet selfcheck: page free-list accounting OK")
+
+
+def _check_prefix_index() -> None:
+    import numpy as np
+
+    from ray_lightning_tpu.serve.fleet.pages import (PrefixIndex,
+                                                     _prefix_hash)
+
+    idx = PrefixIndex(page_size=4)
+    base = np.arange(100, 120, dtype=np.int32)
+    assert idx.register(0, base, limit=19) == 16   # whole pages under 19
+    hit = idx.lookup(np.concatenate([base[:8], [7, 7, 7, 7]]))
+    assert hit == (0, 8), hit
+    hit = idx.lookup(base)                          # longest wins
+    assert hit == (0, 16), hit
+    assert idx.lookup(np.arange(5, dtype=np.int32)) is None
+    # forged collision: same bucket, different tokens must NOT donate
+    other = base[:4].copy()
+    other[0] = 999
+    forged = _prefix_hash(other[:4])
+    idx._by_hash.setdefault(forged, set()).add(0)
+    assert idx.lookup(other) is None, "collision donated"
+    del idx._by_hash[forged]
+    idx.drop(0)
+    assert idx.lookup(base) is None and not idx._by_hash
+    print("fleet selfcheck: prefix-hash round-trip + collision "
+          "verification OK")
+
+
+def _check_autoscaler() -> None:
+    from ray_lightning_tpu.serve.fleet.autoscale import Autoscaler
+    from ray_lightning_tpu.serve.fleet.config import FleetConfig
+
+    clock = [0.0]
+    a = Autoscaler(FleetConfig(min_replicas=1, max_replicas=3,
+                               grow_queue_depth=2, patience_ticks=2,
+                               cooldown_s=5.0, shrink_occupancy=0.5),
+                   clock=lambda: clock[0])
+    hot = {"replicas": 1, "queued": 10, "active": 4, "slots_total": 4}
+    idle = {"replicas": 2, "queued": 0, "active": 0, "slots_total": 8}
+    assert a.tick(hot) is None, "patience ignored"
+    d = a.tick(hot)
+    assert d == {"action": "grow",
+                 "reason": d["reason"]} and "queue_depth" in d["reason"]
+    assert a.tick(hot) is None, "decided while actuating"
+    a.note_actuated(1.5, True)
+    assert a.events[-1]["seconds"] == 1.5 and a.events[-1]["ok"]
+    clock[0] = 2.0
+    for _ in range(4):
+        assert a.tick(hot) is None, "cooldown ignored"
+    clock[0] = 10.0
+    assert a.tick(idle) is None
+    d = a.tick(idle)
+    assert d is not None and d["action"] == "shrink", d
+    a.note_actuated(0.5, True)
+    clock[0] = 100.0
+    # bounds: no shrink below min, no grow above max
+    for _ in range(5):
+        assert a.tick({"replicas": 1, "queued": 0, "active": 0,
+                       "slots_total": 4}) is None
+        assert a.tick({"replicas": 3, "queued": 99, "active": 12,
+                       "slots_total": 12}) is None
+    st = a.stats()
+    assert st["grows"] == 1 and st["shrinks"] == 1
+    print("fleet selfcheck: autoscaler patience/cooldown/bounds OK")
+
+
+def _check_router_policy() -> None:
+    from ray_lightning_tpu.serve.fleet.router import pick_replica
+
+    rows = [{"rid": 0, "active": 2, "queued": 0, "slots": 4},
+            {"rid": 1, "active": 0, "queued": 3, "slots": 4},
+            {"rid": 2, "active": 0, "queued": 1, "slots": 4}]
+    assert pick_replica(rows) == 2, "least-loaded violated"
+    # sticky wins inside slack...
+    assert pick_replica(rows, sticky_rid=1, sticky_slack=2) == 1
+    # ...but never past it
+    assert pick_replica(rows, sticky_rid=0, sticky_slack=1) == 2
+    assert pick_replica([], sticky_rid=0) is None
+
+    # fleet-wide quota conservation under a simulated dispatch loop:
+    # 8 requests from one quota-2 tenant over 3 replicas — dispatched
+    # in-flight never exceeds the quota, every request eventually runs
+    quota, inflight, done, pending = 2, [], 0, list(range(8))
+    sticky = None
+    while pending or inflight:
+        while pending and len(inflight) < quota:
+            rid = pick_replica(rows, sticky)
+            inflight.append((pending.pop(0), rid))
+            sticky = rid
+            assert len(inflight) <= quota, "quota violated"
+        done += 1
+        inflight.pop(0)
+    assert done == 8
+    print("fleet selfcheck: router least-loaded/sticky/quota OK")
+
+
+def _check_metric_names() -> None:
+    from ray_lightning_tpu.telemetry.metrics import validate_metric_name
+    for name in ("rlt_fleet_replicas_total",
+                 "rlt_fleet_queue_depth_total",
+                 "rlt_fleet_active_slots_total",
+                 "rlt_fleet_requests_total",
+                 "rlt_fleet_grow_total", "rlt_fleet_shrink_total",
+                 "rlt_fleet_failover_total",
+                 "rlt_fleet_scale_seconds_total",
+                 "rlt_serve_prefill_tokens_total"):
+        validate_metric_name(name)
+    print("fleet selfcheck: metric names Prometheus-clean")
+
+
+def _main(argv: list) -> int:
+    _check_config_roundtrip()
+    _check_page_pool()
+    _check_prefix_index()
+    _check_autoscaler()
+    _check_router_policy()
+    _check_metric_names()
+    return 0
+
+
+if __name__ == "__main__":   # pragma: no cover - exercised via format.sh
+    import sys
+    sys.exit(_main(sys.argv[1:]))

@@ -166,10 +166,18 @@ class Scheduler:
                  quotas: "dict[str, int] | int | None" = None,
                  max_prefills_per_step: int = 1,
                  default_max_new_tokens: int = 32,
-                 eos_token: Optional[int] = None):
+                 eos_token: Optional[int] = None,
+                 paged: Any = None):
         self.buckets = tuple(buckets)
         self.max_seq_len = int(max_seq_len)
         self.allocator = SlotAllocator(slots)
+        #: paged-KV prefix reuse (serve/fleet/pages.py): page free-list
+        #: accounting, the prefix-hash index, and donor retention of
+        #: finished slots.  None = pre-fleet behavior, byte-identical.
+        self.pages = None
+        if paged is not None and getattr(paged, "enabled", False):
+            from ray_lightning_tpu.serve.fleet.pages import PagedKV
+            self.pages = PagedKV(paged, slots, self.max_seq_len)
         self.max_prefills_per_step = max(1, int(max_prefills_per_step))
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.eos_token = eos_token
@@ -248,7 +256,7 @@ class Scheduler:
         prefills = []
         with self._lock:
             budget = self.max_prefills_per_step
-            while budget > 0 and self.allocator.free_count > 0:
+            while budget > 0:
                 candidates = self._admissible_tenants()
                 if not candidates:
                     break
@@ -256,14 +264,37 @@ class Scheduler:
                 # tokens, then FIFO arrival of the head request
                 tenant = min(candidates, key=lambda t: (
                     t.active, t.served_tokens, self._order[t.queue[0].id]))
-                req = tenant.queue.pop(0)
+                req = tenant.queue[0]
+                # prefix match BEFORE any donor eviction, so admission
+                # pressure never evicts the one donor this request is
+                # about to copy from (its LRU stamp refreshes here too)
+                hit = self.pages.match(req.tokens) \
+                    if self.pages is not None else None
+                if self.allocator.free_count == 0:
+                    # admission pressure evicts the least-recently-
+                    # useful retained prefix donor (fleet/pages.py);
+                    # without paging a full allocator ends admission
+                    evicted = None
+                    if self.pages is not None:
+                        evicted = self.pages.evict_lru_donor(
+                            exclude=hit[0] if hit is not None else None)
+                        if evicted is None and hit is not None:
+                            # the hit donor is the ONLY reclaimable
+                            # slot: admission beats reuse
+                            evicted = self.pages.evict_lru_donor()
+                            if evicted is not None:
+                                hit = None
+                    if evicted is None:
+                        break
+                    self.allocator.release(evicted)
+                tenant.queue.pop(0)
                 slot = self.allocator.acquire()
                 req.slot = slot
                 req.state = "active"
                 req.t_admit = time.monotonic()
                 tenant.active += 1
                 self._by_slot[slot] = req
-                prefills.append({
+                entry = {
                     "req": req.id, "slot": slot, "bucket": req.bucket,
                     "tokens": pad_to_bucket(req.tokens, req.bucket),
                     "length": int(len(req.tokens)),
@@ -271,7 +302,20 @@ class Scheduler:
                     # context propagation (the worker's prefill span
                     # carries it back on the queue channel)
                     "trace": req.trace,
-                })
+                }
+                computed = len(req.tokens)
+                if self.pages is not None:
+                    if hit is not None and hit[1] >= self.pages.page_size:
+                        src, matched = hit
+                        entry["reuse"] = {"src": int(src),
+                                          "matched": int(matched)}
+                        computed = max(1, len(req.tokens) - matched)
+                    self.pages.on_admit(slot, req.tokens, computed)
+                    self._count("rlt_serve_prefill_tokens_total",
+                                len(req.tokens), kind="requested")
+                    self._count("rlt_serve_prefill_tokens_total",
+                                computed, kind="computed")
+                prefills.append(entry)
                 budget -= 1
                 # the queue-wait phase of this request's span tree +
                 # its numeric twin (per-tenant labeled histogram)
@@ -289,7 +333,15 @@ class Scheduler:
         if decode_slots:
             S = self.allocator.slots
             tokens = np.zeros((S,), dtype=np.int32)
-            positions = np.zeros((S,), dtype=np.int32)
+            # dummy decode writes for idle slots: position 0 normally
+            # (overwritten by the slot's admitting prefill), but under
+            # paging the LAST row — position 0 is the first page of
+            # every retained prefix donor, and a dummy write there
+            # would corrupt the donated K/V (fleet/pages.py docstring;
+            # the last row is never registered, and a live slot
+            # overwrites it before it can ever be attended)
+            fill = self.max_seq_len - 1 if self.pages is not None else 0
+            positions = np.full((S,), fill, dtype=np.int32)
             for s in decode_slots:
                 r = self._by_slot[s]
                 tokens[s] = r.generated[-1]
@@ -339,6 +391,9 @@ class Scheduler:
                 tok = int(result["decode"][slot])
                 req.generated.append(tok)
                 req.pos += 1
+                if self.pages is not None:
+                    # lazy page charge as the decode tail grows
+                    self.pages.on_advance(slot, req.pos)
                 self._count("rlt_serve_tokens_total", 1,
                             tenant=req.tenant)
                 self._tenant(req.tenant).served_tokens += 1
@@ -351,7 +406,14 @@ class Scheduler:
             return
         with self._lock:
             self._by_slot.pop(req.slot, None)
-            self.allocator.release(req.slot)
+            # under paging a finished slot with registered prefix pages
+            # is RETAINED as a donor (allocator keeps it; admission
+            # pressure evicts LRU donors in plan()) — the cross-request
+            # half of "shared system prompts prefill once per replica"
+            retained = self.pages.retain(req.slot) \
+                if self.pages is not None else False
+            if not retained:
+                self.allocator.release(req.slot)
             self._tenant(req.tenant).active -= 1
             self.completed += 1
         req._finish()     # stamps t_done — tpot_s is defined only after
@@ -392,6 +454,8 @@ class Scheduler:
                 t.active = 0
             self._by_slot.clear()
             self.allocator = SlotAllocator(self.allocator.slots)
+            if self.pages is not None:
+                self.pages.drop_all()
             self.failed += len(live) + len(queued)
         for r in live + queued:
             r._finish(error)
@@ -411,10 +475,31 @@ class Scheduler:
                         status="failed")
             self._request_span(r, "failed")
 
+    def withdraw_queued(self) -> "list[ServeRequest]":
+        """Pull every not-yet-admitted request out of the tenant queues
+        WITHOUT finishing or failing it — the fleet router's shrink-
+        drain and failover paths re-dispatch the withdrawn requests to
+        a surviving replica (serve/fleet/router.py).  In-flight
+        (admitted) requests are untouched: they hold KV state only this
+        replica has."""
+        with self._lock:
+            out: list[ServeRequest] = []
+            for t in self._tenants.values():
+                out.extend(t.queue)
+                t.queue.clear()
+            for r in out:
+                self._order.pop(r.id, None)
+                r.state = "withdrawn"
+        self._gauge("rlt_serve_queue_depth_total", 0)
+        return out
+
     # -- stats -------------------------------------------------------------
 
     def stats(self) -> dict:
+        pages = {"pages": self.pages.stats()} \
+            if self.pages is not None else {}
         return {
+            **pages,
             "completed": self.completed,
             "failed": self.failed,
             "queued": self.queued_count,

@@ -64,6 +64,9 @@ class ServeSpec:
     seed: int
     telemetry: TelemetryConfig
     compile_cache: CompileCacheConfig
+    #: paged-KV prefix reuse (serve/fleet/pages.py PageConfig); None or
+    #: disabled keeps the engine's pre-fleet program set
+    paged: Any = None
 
 
 class Server:
@@ -90,6 +93,7 @@ class Server:
         default_root_dir: Optional[str] = None,
         telemetry: Any = None,
         compile_cache: Any = None,
+        paged: Any = None,
         worker_env: Optional[dict] = None,
     ):
         if num_workers < 1:
@@ -114,12 +118,15 @@ class Server:
             os.getcwd(), "rlt_serve")
         self.telemetry = TelemetryConfig.resolve(telemetry)
         self.compile_cache = CompileCacheConfig.resolve(compile_cache)
+        from ray_lightning_tpu.serve.fleet.pages import PageConfig
+        self.paged = PageConfig.resolve(paged)
         self.worker_env = dict(worker_env or {})
         self.scheduler = Scheduler(
             self.buckets, self.max_batch_slots, self.max_seq_len,
             quotas=tenant_quotas,
             max_prefills_per_step=max_prefills_per_step,
-            default_max_new_tokens=max_new_tokens, eos_token=eos_token)
+            default_max_new_tokens=max_new_tokens, eos_token=eos_token,
+            paged=self.paged)
         self._weights = self._resolve_weights(module, checkpoint)
         self._backend = None
         self._workers: list = []
@@ -133,6 +140,10 @@ class Server:
         self._draining = False
         self._started = False
         self._error: Optional[BaseException] = None
+        #: postmortem of a mid-serve fleet failure: classified cause +
+        #: the flight-recorder dump paths (telemetry/flight.py), linked
+        #: from the fleet router's failover report
+        self.failure_report: Optional[dict] = None
         self._setup_info: list = []
         self.telemetry_paths: Optional[dict] = None
 
@@ -181,7 +192,8 @@ class Server:
                 buckets=self.buckets, slots=self.max_batch_slots,
                 max_seq_len=self.max_seq_len, seed=self.seed,
                 telemetry=self.telemetry,
-                compile_cache=self.compile_cache)
+                compile_cache=self.compile_cache,
+                paged=self.paged)
             payload = (spec, self._weights)
             ref = None
             if backend.supports_object_store:
@@ -224,6 +236,7 @@ class Server:
             env["RLT_HEARTBEAT_INTERVAL"] = str(
                 self.telemetry.heartbeat_interval)
         env.update(self.compile_cache.worker_env())
+        env.update(self.paged.worker_env())
         env.update(self.worker_env)
         return env
 
@@ -361,11 +374,34 @@ class Server:
                            sched.active_count + sched.queued_count,
                            exc_info=True)
                 self._error = e
+                # black boxes FIRST: dump every rank's flight ring with
+                # the serve cause while the evidence is fresh (the
+                # elastic fit driver's death-classification discipline,
+                # now on the serve pump too), then fail the waiters
+                self.failure_report = self._dump_flights(e)
                 sched.fail_all(e)
                 return
             sched.apply(plan, result)
             if self._profile_ctl is not None:
                 self._profile_ctl.note_step()
+
+    def _dump_flights(self, error: BaseException) -> dict:
+        """Per-rank ``flight_<rank>.json`` dumps for a mid-serve fleet
+        failure (telemetry/flight.py).  Never raises — this runs inside
+        the pump's failure handling."""
+        report: dict = {"cause": repr(error), "flight_paths": {}}
+        if self._agg is None:
+            return report
+        try:
+            self._agg.log_failure_diagnosis()
+            self._agg.dump_flights(
+                range(self.num_workers),
+                cause=f"serve fleet failure: {error!r}")
+            report["flight_paths"] = {
+                int(r): p for r, p in self._agg.flight.dumped.items()}
+        except Exception:
+            _log.warning("serve flight dump failed", exc_info=True)
+        return report
 
     def _drain_queue(self) -> None:
         backend = self._backend
@@ -412,6 +448,8 @@ class Server:
         hits) in one dict."""
         out = {"scheduler": self.scheduler.stats(),
                "setup": self._setup_info}
+        if self.failure_report is not None:
+            out["failure"] = self.failure_report
         if self._started and self._workers:
             try:
                 out["workers"] = self._wait_all(
@@ -444,8 +482,13 @@ class Server:
         if self._agg is not None:
             from ray_lightning_tpu import telemetry
             telemetry.set_active(None)
-            telemetry.flush_metrics()
-            telemetry.disable_metrics()
+            if self.telemetry.metrics:
+                # only tear down the process-wide registry when THIS
+                # server enabled it — a fleet replica running with
+                # metrics=False must not disable the FleetServer's
+                # driver registry on shrink (serve/fleet/router.py)
+                telemetry.flush_metrics()
+                telemetry.disable_metrics()
             if self._metrics_server is not None:
                 self._metrics_server.stop()
             self.telemetry_paths = self._agg.export()

@@ -73,7 +73,8 @@ class ServeWorker(RLTExecutor):
         from ray_lightning_tpu.serve.engine import ServeEngine
         self._engine = ServeEngine(
             spec.module, spec.strategy, spec.buckets, spec.slots,
-            spec.max_seq_len, seed=spec.seed, weights=weights).setup()
+            spec.max_seq_len, seed=spec.seed, weights=weights,
+            paged=getattr(spec, "paged", None)).setup()
         return {
             "rank": rank,
             "mesh": dict(self._engine._mesh.shape),
@@ -145,10 +146,19 @@ class ServeWorker(RLTExecutor):
             for s in decode["slots"]:
                 result["decode"][s] = int(toks[s])
         for p in plan["prefills"]:
+            reuse = p.get("reuse")
             with span("prefill", trace=p.get("trace"),
-                      bucket=p["bucket"], slot=p["slot"]):
-                result["prefill"][p["slot"]] = engine.prefill(
-                    p["slot"], p["tokens"], p["length"], p["bucket"])
+                      bucket=p["bucket"], slot=p["slot"],
+                      reused=(reuse or {}).get("matched", 0)):
+                if reuse is not None:
+                    # prefix-cache hit (serve/fleet/pages.py): copy the
+                    # matched donor pages, compute only the suffix
+                    result["prefill"][p["slot"]] = engine.prefill_reused(
+                        p["slot"], reuse["src"], p["tokens"],
+                        p["length"], reuse["matched"])
+                else:
+                    result["prefill"][p["slot"]] = engine.prefill(
+                        p["slot"], p["tokens"], p["length"], p["bucket"])
         if self._profiler is not None:
             self._profiler.note_step()
         return result if self._rank == 0 else None

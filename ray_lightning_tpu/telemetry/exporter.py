@@ -136,7 +136,8 @@ class MetricsHTTPServer:
     trials never collide."""
 
     def __init__(self, aggregator, port: int = 0,
-                 host: str = "127.0.0.1", profile_controller=None):
+                 host: str = "127.0.0.1", profile_controller=None,
+                 status_extra=None):
         agg = aggregator
         profiler = profile_controller
 
@@ -147,8 +148,13 @@ class MetricsHTTPServer:
                         body = render_prometheus(agg).encode()
                         ctype = "text/plain; version=0.0.4; charset=utf-8"
                     elif self.path.split("?")[0] == "/status":
-                        body = json.dumps(
-                            render_status(agg, profiler)).encode()
+                        doc = render_status(agg, profiler)
+                        if status_extra is not None:
+                            # caller-owned status block (the fleet
+                            # router's replica/autoscale/failover view,
+                            # serve/fleet/router.py)
+                            doc.update(status_extra())
+                        body = json.dumps(doc).encode()
                         ctype = "application/json"
                     else:
                         self.send_error(404)
@@ -221,7 +227,8 @@ class MetricsHTTPServer:
 
 
 def start_metrics_server(aggregator, cfg,
-                         profile_controller=None
+                         profile_controller=None,
+                         status_extra=None
                          ) -> Optional[MetricsHTTPServer]:
     """Start the driver endpoint when the config asks for one.
 
@@ -246,7 +253,8 @@ def start_metrics_server(aggregator, cfg,
     try:
         server = MetricsHTTPServer(
             aggregator, port=port,
-            profile_controller=profile_controller).start()
+            profile_controller=profile_controller,
+            status_extra=status_extra).start()
     except OSError as e:
         _log.warning("metrics exporter: could not bind port %s (%s); "
                      "run continues without a live endpoint", port, e)
