@@ -54,6 +54,27 @@ def _model():
                         batch_size=16)
 
 
+def _bubble_goodput_view(rec: dict) -> "dict | None":
+    """The leg's timed window as a goodput partition: all wall is
+    ``step`` (the window excludes compile/init by construction), and
+    the measured anatomy sub-splits it — ``bubble_s`` is the
+    schedule-idle share the 1f1b-vs-gpipe claim is about."""
+    anatomy = rec.get("anatomy")
+    sps = rec.get("value")
+    if not anatomy or not sps:
+        return None
+    from ray_lightning_tpu.telemetry.goodput import GoodputLedger
+    wall = TIMED / float(sps)
+    ledger = GoodputLedger("fit")
+    ledger.note_step(wall, k=TIMED)
+    ledger.set_anatomy(anatomy)
+    doc = ledger.finalize(wall)
+    return {"run_wall_s": doc["run_wall_s"],
+            "buckets": doc["buckets"],
+            "goodput_fraction": doc["goodput_fraction"],
+            "useful_split": doc["useful_split"]}
+
+
 def main() -> None:
     import jax
 
@@ -102,10 +123,12 @@ def main() -> None:
                 c: activation_wire_bytes(boundary, STAGES - 1, MICRO,
                                          codec=c)
                 for c in ("none", "bf16", "int8", "fp8", "int4")}}
-        # measured-bubble leg: the 1f1b run also captures a warm-tail
-        # trace, whose anatomy host-gap fraction is the MEASURED bubble
-        # (telemetry/anatomy.py) next to the replay-simulated one
-        trace_steps = 4 if tag == "mpmd_1f1b" else 0
+        # measured-bubble legs (ROADMAP 5b): the gpipe and 1f1b runs
+        # each capture a warm-tail trace, whose anatomy host-gap
+        # fraction is the MEASURED bubble (telemetry/anatomy.py) next
+        # to the replay-simulated one — and the ledger gates both
+        # (benchmarks/ledger.py measured_bubble_fraction_* bands)
+        trace_steps = 4 if tag in ("mpmd_gpipe", "mpmd_1f1b") else 0
         results[tag] = run_steps_per_sec(
             _model(), f"{tag}_steps_per_sec", warmup=WARMUP,
             timed=TIMED, strategy=MpmdPipelineStrategy(cfg),
@@ -118,6 +141,8 @@ def main() -> None:
         "bubble_fraction", {})
     measured = (results["mpmd_1f1b"].get("anatomy") or {}).get(
         "bubble_fraction")
+    measured_gpipe = (results["mpmd_gpipe"].get("anatomy") or {}).get(
+        "bubble_fraction")
     print(json.dumps({
         "metric": "mpmd_bubble_win",
         "gpipe_bubble_fraction": bubbles.get("gpipe"),
@@ -125,6 +150,12 @@ def main() -> None:
         "1f1b_below_gpipe": (
             bubbles.get("1f1b", 1.0) < bubbles.get("gpipe", 0.0)),
         "measured_bubble_fraction_1f1b": measured,
+        "measured_bubble_fraction_gpipe": measured_gpipe,
+        # goodput-bucket view of the bubble (telemetry/goodput.py):
+        # the 1f1b leg's timed window recast as a goodput partition,
+        # with the measured bubble carved out of the useful bucket's
+        # sub-split — the same shape the fit/serve surfaces report
+        "goodput_view": _bubble_goodput_view(results["mpmd_1f1b"]),
         "microbatches": MICRO,
         "note": "bubble_fraction legs are simulated from measured "
                 "per-op seconds; measured_bubble_fraction_1f1b is the "

@@ -182,6 +182,17 @@ def run_steps_per_sec(module, metric: str, *, warmup: int = 3,
         anatomy = parse_anatomy_or_none(timer.trace_dir)
         if anatomy is not None:
             result["anatomy"] = anatomy
+    # goodput plane (telemetry/goodput.py): the run's wall-clock
+    # partition + measured MFU, compacted to the fields the ledger
+    # gates on (benchmarks/ledger.py goodput-fraction / MFU bands)
+    gp = getattr(trainer, "_goodput_report", None)
+    if gp:
+        result["goodput"] = {
+            "fraction": gp.get("goodput_fraction"),
+            "mfu": gp.get("mfu"),
+            "run_wall_s": gp.get("run_wall_s"),
+            "buckets": gp.get("buckets"),
+        }
     paths = getattr(trainer, "_telemetry_paths", None)
     if paths:
         result["telemetry_jsonl"] = paths["jsonl"]

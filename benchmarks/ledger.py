@@ -35,11 +35,23 @@ figure                          worse    band
 ``serve.ttft_p99_ms`` /
 ``fleet.ttft_p99_ms``           higher  ``serve_band`` +
                                         ``min_ttft_ms`` floor
+``goodput.fraction``            lower   ``goodput_band`` (default 10%)
+                                        + ``min_goodput_delta``
+                                        absolute floor
+``goodput.mfu``                 lower   ``goodput_band``
+``measured_bubble_fraction_*``  higher  ``goodput_band`` + the same
+                                        absolute floor (bench_pipeline
+                                        1f1b/gpipe audit)
 ==============================  ======  ==============================
 
 Improvements are reported too (the ledger is a trajectory, not just an
 alarm); metrics present on only one side are listed as uncompared so a
-silently dropped leg can't read as "no regression".
+silently dropped leg can't read as "no regression".  A figure present
+on only ONE side of a joined metric (a field added or dropped between
+rounds — e.g. comparing a goodput-aware round against a pre-goodput
+``BENCH_r*.json``) is skipped with a note in ``skipped``, never a
+KeyError and never a regression: new instrumentation bootstraps
+cleanly against old baselines.
 """
 
 from __future__ import annotations
@@ -58,6 +70,13 @@ SERVE_BAND = 0.15
 MIN_EXPOSED_S = 1e-4
 #: absolute TTFT floor: p99 jitter below this is scheduler noise
 MIN_TTFT_MS = 2.0
+#: goodput-fraction / MFU band (telemetry/goodput.py): whole-run wall
+#: attribution swings more than compiled-step time (compile/init share
+#: varies with cache state), so the band is wider than step_band
+GOODPUT_BAND = 0.10
+#: absolute goodput-fraction / bubble-fraction floor: drift smaller
+#: than 2 points of fraction is wall-clock noise, not a regression
+MIN_GOODPUT_DELTA = 0.02
 
 
 def _iter_records(obj: Any) -> Iterable[dict]:
@@ -116,6 +135,7 @@ def _exposed_of(rec: dict) -> "float | None":
 def compare(prev: Any, curr: Any, *, step_band: float = STEP_BAND,
             exposed_band: float = EXPOSED_BAND,
             serve_band: float = SERVE_BAND,
+            goodput_band: float = GOODPUT_BAND,
             min_exposed_s: float = MIN_EXPOSED_S,
             min_ttft_ms: float = MIN_TTFT_MS) -> dict:
     """Compare two rounds; the returned report's ``ok`` is the gate.
@@ -126,10 +146,22 @@ def compare(prev: Any, curr: Any, *, step_band: float = STEP_BAND,
     curr_by = load_records(curr)
     regressions: list[dict] = []
     improvements: list[dict] = []
+    skipped: list[dict] = []
     compared = 0
 
     def check(metric, figure, old, new, worse_is, band, floor=0.0):
         nonlocal compared
+        if (old is None) != (new is None):
+            # one-sided figure: a field this round of instrumentation
+            # added (old side predates it) or dropped.  Note it —
+            # silence would read as "compared, fine" — but never gate:
+            # new figures must bootstrap cleanly against old rounds
+            skipped.append({
+                "metric": metric, "figure": figure,
+                "note": ("not in previous round (bootstrapping)"
+                         if old is None else
+                         "missing from current round")})
+            return
         if old is None or new is None or old <= 0:
             return
         compared += 1
@@ -168,16 +200,40 @@ def compare(prev: Any, curr: Any, *, step_band: float = STEP_BAND,
             check(metric, f"{key}.ttft_p99_ms", ps.get("ttft_p99_ms"),
                   cs.get("ttft_p99_ms"), "higher", serve_band,
                   floor=min_ttft_ms)
+        # goodput plane (telemetry/goodput.py `goodput` dict): the
+        # useful-fraction of run wall and measured MFU are both
+        # lower-is-worse; one-sided presence (a pre-goodput baseline)
+        # lands in `skipped` via check()'s bootstrap path
+        pg = p.get("goodput") if isinstance(p.get("goodput"), dict) \
+            else {}
+        cg = c.get("goodput") if isinstance(c.get("goodput"), dict) \
+            else {}
+        if pg or cg:
+            check(metric, "goodput.fraction", pg.get("fraction"),
+                  cg.get("fraction"), "lower", goodput_band,
+                  floor=MIN_GOODPUT_DELTA)
+            check(metric, "goodput.mfu", pg.get("mfu"), cg.get("mfu"),
+                  "lower", goodput_band)
+        # measured pipeline-bubble fractions (bench_pipeline.py anatomy
+        # audit): schedule-idle share of device time, higher-is-worse
+        for fig in ("measured_bubble_fraction_1f1b",
+                    "measured_bubble_fraction_gpipe"):
+            if p.get(fig) is not None or c.get(fig) is not None:
+                check(metric, fig, p.get(fig), c.get(fig), "higher",
+                      goodput_band, floor=MIN_GOODPUT_DELTA)
     report = {
         "metric": "perf_ledger",
         "compared": compared,
         "regressions": regressions,
         "improvements": improvements,
+        "skipped": skipped,
         "only_prev": sorted(set(prev_by) - set(curr_by)),
         "only_curr": sorted(set(curr_by) - set(prev_by)),
         "bands": {"step": step_band, "exposed": exposed_band,
-                  "serve": serve_band, "min_exposed_s": min_exposed_s,
-                  "min_ttft_ms": min_ttft_ms},
+                  "serve": serve_band, "goodput": goodput_band,
+                  "min_exposed_s": min_exposed_s,
+                  "min_ttft_ms": min_ttft_ms,
+                  "min_goodput_delta": MIN_GOODPUT_DELTA},
         "ok": not regressions,
     }
     return report
@@ -200,10 +256,16 @@ def main(argv: list) -> int:
     parser.add_argument("--serve-band", type=float, default=SERVE_BAND,
                         help="relative band for serve/fleet tokens-per-"
                         f"sec and TTFT p99 (default {SERVE_BAND})")
+    parser.add_argument("--goodput-band", type=float,
+                        default=GOODPUT_BAND,
+                        help="relative band for goodput fraction, MFU "
+                        "and measured bubble fractions "
+                        f"(default {GOODPUT_BAND})")
     args = parser.parse_args(argv)
     report = compare(args.prev, args.curr, step_band=args.step_band,
                      exposed_band=args.exposed_band,
-                     serve_band=args.serve_band)
+                     serve_band=args.serve_band,
+                     goodput_band=args.goodput_band)
     print(json.dumps(report))
     return 0 if report["ok"] else 1
 

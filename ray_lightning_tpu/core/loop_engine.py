@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_lightning_tpu.telemetry import span
+from ray_lightning_tpu.telemetry import goodput as _goodput
 from ray_lightning_tpu.telemetry import metrics as _metrics
 from ray_lightning_tpu.telemetry.anatomy import anatomy_tick
 from ray_lightning_tpu.telemetry.tracing import profile_tick
@@ -154,7 +155,9 @@ class StreamSource:
                                     payload=batch)
             return None
         finally:
-            _metrics.on_data_wait(time.monotonic() - t0)
+            waited = time.monotonic() - t0
+            _metrics.on_data_wait(waited)
+            _goodput.on_data_wait(waited)
 
     def _start_transfer(self, item: Item) -> None:
         if item.device is not None:

@@ -237,6 +237,17 @@ class LightningModule:
         models/gpt.py for the reference implementation."""
         return None
 
+    def flops_per_step(self) -> "float | None":
+        """Goodput-plane hook (telemetry/goodput.py): FLOPs one
+        optimizer step executes over the global batch, the measured-MFU
+        numerator.  Default ``None`` = the trainer prices the built
+        train-step jaxpr itself (every ``dot_general``, forward +
+        backward + update — core/remat.py ``step_dot_flops``), which is
+        exact for matmul-dominated models.  Override when the analytic
+        number is known (e.g. the 6·params·tokens transformer estimate)
+        or the model's FLOPs are not dot-dominated."""
+        return None
+
     def configure_mpmd(self):
         """MPMD-plane hook (ray_lightning_tpu/mpmd/): an ``MpmdSpec``
         describing this model as embed → N identical layers → head so

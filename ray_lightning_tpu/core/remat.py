@@ -180,6 +180,15 @@ def grad_dot_flops(fn, *args) -> int:
     return _dot_flops_of_jaxpr(jax.make_jaxpr(g)(*args).jaxpr)
 
 
+def step_dot_flops(fn, *args) -> int:
+    """Matmul FLOPs of ``fn``'s OWN jaxpr — for programs that already
+    contain their backward (a built train step: forward + grad +
+    optimizer update), where :func:`grad_dot_flops` would differentiate
+    a second time.  The goodput plane's default ``flops_per_step``
+    pricing (telemetry/goodput.py measured MFU)."""
+    return _dot_flops_of_jaxpr(jax.make_jaxpr(fn)(*args).jaxpr)
+
+
 def block_cost(fn, base_fn, *args, base_flops=None) -> "tuple[int, int]":
     """(saved computed-residual bytes of ``fn``, extra backward matmul
     FLOPs of ``fn`` vs the un-remat'd ``base_fn``).  Pass
@@ -203,4 +212,5 @@ __all__ = [
     "grad_dot_flops",
     "policy_object",
     "saved_activation_bytes",
+    "step_dot_flops",
 ]

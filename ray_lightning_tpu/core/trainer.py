@@ -258,6 +258,11 @@ class Trainer:
         #: the winning plan's donation decision, consulted by
         #: _should_donate between the RLT_DONATE force and the heuristic
         self._plan_donate: Optional[bool] = None
+        #: goodput plane (telemetry/goodput.py): this rank's finalized
+        #: ledger doc, and the driver-side fleet aggregate the bench
+        #: harness reads (plugins set it in their teardown)
+        self._goodput_local: Optional[dict] = None
+        self._goodput_report: Optional[dict] = None
 
     # ------------------------------------------------------------------
     # pickling across the driver→worker boundary (ray_ddp.py:164-172
@@ -443,15 +448,36 @@ class Trainer:
         self._mesh = strategy.build_mesh(self.plugin.local_devices(),
                                          batch_hint=batch_hint)
         set_current_mesh(self._mesh)  # for mesh-aware ops (ring attention)
+        # goodput plane (telemetry/goodput.py): one ledger per fit run,
+        # backdated to the stage clock so the partition covers every
+        # second of stage wall (compile and init included).  The plugin
+        # armed the plane (or didn't); start_run is a no-op when off.
+        self._goodput_ledger = None
+        if stage == "fit":
+            from ray_lightning_tpu.telemetry import goodput as _goodput
+            self._goodput_ledger = _goodput.start_run("fit")
+            if self._goodput_ledger is not None:
+                self._goodput_ledger._t0 = self._stage_t0
+                self._goodput_ledger.devices = int(self._mesh.devices.size)
+                tfl = self.telemetry.resolved_goodput_tflops()
+                self._goodput_ledger.device_tflops = (
+                    float(tfl) if tfl is not None
+                    else float(self.plan.device_tflops))
         self._cache_bytes_hint = (
             _cache_bytes_estimate(loaders.get("train"), example_batch)
             if stage == "fit" and self.cache_train_dataset else 0)
         # "compile" covers trace construction + jit setup; the first
         # "step" span additionally contains the XLA compile of the train
         # program (jax compiles lazily at first dispatch)
+        t_compile = time.monotonic()
         with span("compile"):
             self._build_compiled(module, example_batch, strategy)
         _metrics.on_compile()
+        if self._goodput_ledger is not None:
+            self._goodput_ledger.add("compile",
+                                     time.monotonic() - t_compile)
+            self._goodput_ledger.set_flops_per_step(
+                self._price_flops_per_step(module))
         if _metrics.metrics_enabled():
             # the gradient/param collectives XLA compiles into the step
             # from the strategy's shardings have no host call site; the
@@ -480,8 +506,11 @@ class Trainer:
                 op_bytes,
                 dcn_bytes=declared_dcn_bytes(op_bytes,
                                              jax.process_count() > 1))
+        t_init = time.monotonic()
         with span("init"):
             self._init_state(module, example_batch, strategy, ckpt_path)
+        if self._goodput_ledger is not None:
+            self._goodput_ledger.add("init", time.monotonic() - t_init)
 
         for cb in self.callbacks:
             cb.setup(self, module, stage)
@@ -500,7 +529,69 @@ class Trainer:
             set_current_mesh(None)
             for cb in self.callbacks:
                 cb.teardown(self, module, stage)
+            self._close_goodput_ledger()
         return result
+
+    def _close_goodput_ledger(self) -> None:
+        """Finalize this stage's goodput ledger: fold the snapshotter's
+        off-loop costs in, attach the latest measured anatomy window as
+        the useful bucket's sub-split, close the partition against the
+        stage wall, and keep the doc (``_goodput_local``) for the rank-0
+        result package + the telemetry sink."""
+        ledger = getattr(self, "_goodput_ledger", None)
+        if ledger is None:
+            return
+        self._goodput_ledger = None
+        if self._snapshotter is not None:
+            stats = self._snapshotter.stats
+            ledger.add("snapshot", stats.get("save_seconds", 0.0))
+            ledger.add("snapshot_stall", stats.get("stall_seconds", 0.0))
+        try:
+            from ray_lightning_tpu.telemetry import anatomy as _anatomy
+            ctl = _anatomy.get_anatomy_controller()
+            if ctl is not None and ctl.last:
+                ledger.set_anatomy(ctl.last)
+        except Exception:   # anatomy must never break the partition
+            pass
+        from ray_lightning_tpu.telemetry import goodput as _goodput
+        self._goodput_local = _goodput.finish_run()
+
+    def _attach_observed_divergence(self, agg) -> None:
+        """Close the planner's loop against the run's measurements:
+        when a plan report exists and anatomy windows landed, attach
+        the MEASURED per-step wall + exposed comm next to the winner's
+        modeled ``comm_seconds`` (the ``observed`` field of
+        plan/report.py) so model-vs-reality divergence is a number.
+        No re-ranking happens here — the next plan still starts from
+        the model; this only makes the model's error visible."""
+        report = getattr(self, "_plan_report", None)
+        if not report:
+            return
+        try:
+            anatomy = agg.anatomy_stats()
+        except Exception:
+            return
+        per_rank = (anatomy or {}).get("per_rank") or {}
+        walls = [a.get("wall_s", 0.0) for a in per_rank.values()]
+        exposed = [a.get("exposed_s", 0.0) for a in per_rank.values()]
+        if not walls or max(walls) <= 0:
+            return
+        winner = next((e for e in report.get("candidates", ())
+                       if e.get("status") == "winner"), None)
+        modeled_comm = ((winner or {}).get("modeled") or {}) \
+            .get("comm_seconds")
+        # fleet step = the slowest rank's measured wall (SPMD lockstep)
+        step_wall = max(walls)
+        exposed_comm = max(exposed)
+        observed = {
+            "step_wall_s": round(step_wall, 6),
+            "exposed_comm_s": round(exposed_comm, 6),
+            "modeled_comm_s": (round(float(modeled_comm), 6)
+                               if modeled_comm is not None else None),
+            "ratio": (round(exposed_comm / float(modeled_comm), 3)
+                      if modeled_comm else None),
+        }
+        report["observed"] = observed
 
     # -- data -----------------------------------------------------------
 
@@ -831,6 +922,9 @@ class Trainer:
         step_fn = build_train_step(module, self._tx,
                                    self.accumulate_grad_batches,
                                    grad_sync=self._grad_sync)
+        #: un-jitted step for the goodput plane's default FLOP pricing
+        #: (tracing only — never dispatched)
+        self._pricing_step_fn = step_fn
         self._train_step = jax.jit(step_fn, **jit_kwargs)
         self._multi_train_step = None
         self._stacked_batch_shardings = None
@@ -887,6 +981,36 @@ class Trainer:
         self._predict_step = _ShardedStepCache(build_predict_step(module),
                                                self, strategy)
         self._submit_precompiles(example_batch)
+
+    def _price_flops_per_step(self, module) -> "Optional[float]":
+        """FLOPs one optimizer step executes, for measured MFU: the
+        module's ``flops_per_step()`` hook when it answers, else the
+        default pricing — count every ``dot_general`` in the train-step
+        jaxpr (forward + backward + update) over the abstract state and
+        global abstract batch, the same dot-counting machinery the
+        remat planner prices policies with (core/remat.py).  None when
+        neither source can answer; MFU is then simply absent — never
+        fabricated."""
+        try:
+            flops = module.flops_per_step()
+        except Exception:
+            _log.debug("goodput: flops_per_step() hook raised; falling "
+                       "back to jaxpr pricing", exc_info=True)
+            flops = None
+        if flops is not None:
+            return float(flops)
+        step_fn = getattr(self, "_pricing_step_fn", None)
+        abstract_batch = getattr(self, "_abstract_batch", None)
+        if step_fn is None or abstract_batch is None:
+            return None
+        try:
+            from ray_lightning_tpu.core.remat import step_dot_flops
+            return float(step_dot_flops(step_fn, self._abstract_state,
+                                        abstract_batch))
+        except Exception:
+            _log.debug("goodput: default train-step FLOP pricing "
+                       "failed; MFU unavailable", exc_info=True)
+            return None
 
     def _submit_precompiles(self, example_batch) -> None:
         """AOT-compile the step programs in the background (compile/):
@@ -1249,7 +1373,10 @@ class Trainer:
         with span("step", step=self.global_step):
             metrics = source.run_one(self, item)
         self.global_step += 1
-        _metrics.on_step(time.monotonic() - t0, step=self.global_step)
+        step_s = time.monotonic() - t0
+        _metrics.on_step(step_s, step=self.global_step)
+        if self._goodput_ledger is not None:
+            self._goodput_ledger.note_step(step_s)
         if self._redundancy is not None:
             # parity BEFORE the snapshot: a rank that dies inside the
             # save (snapkill) has already escrowed this step
@@ -1283,8 +1410,10 @@ class Trainer:
         with span("step", step=before, k=len(items)):
             metrics = source.run_chunk(self, items)
         self.global_step += len(items)
-        _metrics.on_step(time.monotonic() - t0, k=len(items),
-                         step=self.global_step)
+        step_s = time.monotonic() - t0
+        _metrics.on_step(step_s, k=len(items), step=self.global_step)
+        if self._goodput_ledger is not None:
+            self._goodput_ledger.note_step(step_s, k=len(items))
         if self._redundancy is not None:
             # chunked dispatch coarsens the parity cadence to chunk
             # boundaries, exactly like the snapshot cadence below

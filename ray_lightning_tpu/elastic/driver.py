@@ -211,6 +211,28 @@ def run_elastic_fit(plugin, trainer, module, datamodule,
             trainer._elastic_recovery = route["package"]
             plugin._elastic_recovery_mode = route["mode"]
             plugin._elastic_recovery_seconds = decision_s
+            # replayed-step badput (telemetry/goodput.py): how many
+            # steps the resumed attempt re-executes because the resume
+            # point is behind the crash step.  Parity reconstructs AT
+            # the crash step (→ ~0); snapshot replay resumes at the
+            # last durable snapshot (→ crash_step - resumed_step).
+            # The crash step is the failed fleet's last scraped
+            # rlt_steps_total, read off the attempt's aggregator.
+            crash_step = None
+            agg = getattr(plugin, "_telemetry_agg", None)
+            if agg is not None:
+                try:
+                    steps = [b["step"] for b in
+                             agg.metrics_briefs().values()
+                             if b.get("step") is not None]
+                    crash_step = max(steps) if steps else None
+                except Exception:   # accounting must never block recovery
+                    crash_step = None
+            replayed = 0
+            if crash_step is not None:
+                replayed = max(0, int(crash_step)
+                               - int(route["step"] or 0))
+            plugin._elastic_replayed_steps = replayed
             resume = route["resume"]
             if route["mode"] == "scratch":
                 _log.warning(
@@ -238,7 +260,8 @@ def run_elastic_fit(plugin, trainer, module, datamodule,
                       "resumed_step": route["step"],
                       "resumed_from": resume,
                       "recovery": route["mode"],
-                      "recovery_decision_seconds": decision_s}
+                      "recovery_decision_seconds": decision_s,
+                      "replayed_steps": replayed}
             if route["package"] is not None:
                 # the dead fleet's parity counters rode the escrow —
                 # its workers never returned a result package

@@ -21,7 +21,7 @@ from typing import Optional
 #: axes (None when the module has no configure_remat() ladder).
 REPORT_KEYS = ("winner", "topk", "plan_seconds", "cache_misses",
                "reused", "enumerated", "pruned", "rejected", "scored",
-               "compiled", "candidates", "remat")
+               "compiled", "candidates", "remat", "observed")
 
 #: keys every per-candidate entry carries
 ENTRY_KEYS = ("label", "strategy", "mesh", "comm", "donate",
@@ -96,6 +96,11 @@ class PlanReport:
             "compiled": compiled,
             "candidates": list(self.entries),
             "remat": self._remat_summary(),
+            # measured-vs-modeled divergence for the WINNER, attached
+            # after the run by Trainer._attach_observed_divergence()
+            # when anatomy windows landed: {step_wall_s, exposed_comm_s,
+            # modeled_comm_s, ratio}.  None until a run measures it.
+            "observed": None,
         }
 
     def summary(self) -> str:

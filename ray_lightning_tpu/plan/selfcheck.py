@@ -193,6 +193,8 @@ def _check_report_schema() -> None:
     assert d["enumerated"] == 3 and d["pruned"] == 1 \
         and d["rejected"] == 1 and d["compiled"] == 1
     assert d["winner"] == cand.label
+    assert d["observed"] is None, \
+        "observed divergence must be None until a run measures it"
     print("plan selfcheck: report schema pinned")
 
 

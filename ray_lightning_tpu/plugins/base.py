@@ -58,6 +58,11 @@ class LocalPlugin(ExecutionPlugin):
         telemetry.set_active(agg)
         telemetry.enable(rank=0, sink=lambda recs: agg.ingest_records(
             0, recs), capacity=cfg.capacity, flush_every=cfg.flush_every)
+        if cfg.resolved_goodput():
+            # goodput plane (telemetry/goodput.py): the trainer opens
+            # the run ledger inside _run_stage; arming here gives the
+            # finalized doc a direct path onto the aggregator
+            telemetry.enable_goodput(rank=0, sink=agg.maybe_ingest)
         every_n, window = cfg.resolved_anatomy()
         if every_n is not None:
             # cadence-armed anatomy windows (telemetry/anatomy.py): the
@@ -85,6 +90,7 @@ class LocalPlugin(ExecutionPlugin):
         try:
             return trainer._run_stage(module, datamodule, stage, ckpt_path)
         finally:
+            telemetry.disable_goodput()
             telemetry.disable_anatomy()
             telemetry.flush_metrics()
             telemetry.disable_metrics()
@@ -99,6 +105,13 @@ class LocalPlugin(ExecutionPlugin):
             trainer._telemetry_paths = agg.export()
             if server is not None:
                 trainer._telemetry_paths["metrics_url"] = server.url
+            # driver-side goodput report + the planner's measured-vs-
+            # modeled divergence (both read the aggregator this plugin
+            # owns, so they land here in the teardown)
+            gp = agg.goodput_stats()
+            if gp:
+                trainer._goodput_report = gp.get("fleet")
+            trainer._attach_observed_divergence(agg)
 
     def local_devices(self):
         if self._devices is not None:

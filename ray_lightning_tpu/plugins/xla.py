@@ -129,6 +129,9 @@ def _worker_run(payload: tuple, rank: int, queue,
         # the planner's verdict when strategy="auto" ran in the workers
         # (every rank plans identically; rank 0's copy is THE report)
         "plan_report": trainer._plan_report,
+        # rank 0's finalized goodput doc (telemetry/goodput.py) — the
+        # driver's fallback when the queue-shipped copy was dropped
+        "goodput": getattr(trainer, "_goodput_local", None),
     }
     if stage == "fit":
         # Weights return in-band as a state stream — PL's temp-file
@@ -174,6 +177,12 @@ def _setup_worker_telemetry(trainer, rank: int, queue):
         telemetry.enable_anatomy(
             rank=rank, every_n=every_n, window=window,
             sink=lambda item, _q=queue, _rank=rank: _q.put((_rank, item)))
+    if cfg.resolved_goodput():
+        # goodput plane (telemetry/goodput.py): the run ledger opens
+        # inside _run_stage; the finalized doc rides the same queue
+        telemetry.enable_goodput(
+            rank=rank,
+            sink=lambda item, _q=queue, _rank=rank: _q.put((_rank, item)))
     if hb_mod.process_heartbeat_active():
         return None  # worker_main (built-in backend) already beats
     return hb_mod.HeartbeatSender(
@@ -190,6 +199,7 @@ def _teardown_worker_telemetry(trainer, hb) -> None:
     # not an anatomy), then the final metrics window: its cumulative
     # counters must be on the queue before the spans flush that follows
     # the last step
+    telemetry.disable_goodput()
     telemetry.disable_anatomy()
     telemetry.flush_metrics()
     telemetry.disable_metrics()
@@ -416,6 +426,11 @@ class RayXlaPlugin(ExecutionPlugin):
             agg.set_recovery(getattr(self, "_elastic_recovery_mode", None),
                              getattr(self, "_elastic_recovery_seconds",
                                      None))
+            # snapshot-replay badput: steps this attempt re-executes
+            # because the snapshot was behind the crash step
+            # (elastic/driver.py sets it when routing to replay)
+            agg.set_replayed_steps(
+                getattr(self, "_elastic_replayed_steps", 0))
             for i, w in enumerate(self._workers):
                 agg.register_worker(i, w)
             telemetry.set_active(agg)
@@ -481,6 +496,14 @@ class RayXlaPlugin(ExecutionPlugin):
                 trainer._telemetry_paths = agg.export()
                 if server is not None:
                     trainer._telemetry_paths["metrics_url"] = server.url
+                # fleet goodput aggregate + the planner's measured-vs-
+                # modeled divergence, from the docs the workers shipped
+                # over the queue (rank-0 package fallback in
+                # _post_dispatch when the queue copy was dropped)
+                gp = agg.goodput_stats()
+                if gp:
+                    trainer._goodput_report = gp.get("fleet")
+                trainer._attach_observed_divergence(agg)
 
     def _execution_loop(self, trainer, module, datamodule, stage, ckpt_path,
                         backend):
@@ -614,6 +637,11 @@ class RayXlaPlugin(ExecutionPlugin):
         trainer._elastic_worker_stats = rank0.get("elastic")
         if rank0.get("plan_report") is not None:
             trainer._plan_report = rank0.get("plan_report")
+        if rank0.get("goodput") is not None:
+            # rank 0's own doc as the provisional report; _run_attempt's
+            # teardown upgrades it to the fleet aggregate when the
+            # queue-shipped docs reached the aggregator
+            trainer._goodput_report = rank0.get("goodput")
         if stage == "fit":
             stream = rank0.get("state_stream")
             if stream is not None:

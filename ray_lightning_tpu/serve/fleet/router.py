@@ -209,6 +209,9 @@ class FleetServer:
         self._retired_pages = {"prefill_tokens_requested": 0,
                                "prefill_tokens_computed": 0,
                                "prefix_hits": 0, "reused_prefills": 0}
+        #: finalized goodput docs of removed replicas (same rationale:
+        #: a shrink must not erase the fleet's wall-clock attribution)
+        self._retired_goodput: list = []
         self.completed = 0
         self.failed = 0
         self.requeued = 0
@@ -494,6 +497,17 @@ class FleetServer:
             for key in self._retired_pages:
                 self._retired_pages[key] += st[key]
 
+    def _fold_goodput(self, rep: FleetReplica) -> None:
+        """Preserve a departing replica's goodput partition (the pump
+        finalized its ledger during shutdown)."""
+        try:
+            doc = rep.server.goodput()
+        except Exception:
+            doc = None
+        if doc:
+            with self._lock:
+                self._retired_goodput.append(doc)
+
     def _reap_async(self, rep: FleetReplica) -> None:
         def reap():
             try:
@@ -501,6 +515,7 @@ class FleetServer:
             except Exception:
                 pass
             self._fold_pages(rep)
+            self._fold_goodput(rep)
             with self._lock:
                 self._replicas.pop(rep.id, None)
         t = threading.Thread(target=reap, daemon=True,
@@ -655,6 +670,7 @@ class FleetServer:
                     _log.warning("fleet shrink: replica %d shutdown "
                                  "failed", rep.id, exc_info=True)
                 self._fold_pages(rep)
+                self._fold_goodput(rep)
                 with self._lock:
                     self._replicas.pop(rep.id, None)
                 _log.info("fleet shrink: replica %d drained and "
@@ -758,7 +774,36 @@ class FleetServer:
         }
         if pages:
             doc["fleet"]["pages"] = pages
+        gp = self.goodput_stats()
+        if gp:
+            doc["fleet"]["goodput"] = gp
         return doc
+
+    def goodput_stats(self) -> Optional[dict]:
+        """Fleet goodput: every replica pump's wall-clock partition
+        (live peeks for serving replicas, finalized docs for retired
+        ones) aggregated, with the autoscaler's actuation seconds as
+        an extra ``autoscale`` bucket.  Actuation runs on router
+        threads — never inside a replica pump — so adding it to both
+        the wall and its bucket keeps ``sum(buckets) == run_wall``
+        true on the aggregate by construction."""
+        from ray_lightning_tpu.telemetry import goodput as _goodput
+        with self._lock:
+            reps = list(self._replicas.values())
+            docs = list(self._retired_goodput)
+        for rep in reps:
+            try:
+                doc = rep.server.goodput()
+            except Exception:
+                doc = None
+            if doc:
+                docs.append(doc)
+        if not docs:
+            return None
+        actuation = sum(float(e.get("seconds") or 0.0)
+                        for e in self.autoscaler.stats().get("events", ()))
+        return _goodput.aggregate(
+            docs, extra_buckets={"autoscale": actuation})
 
     def pages_stats(self) -> Optional[dict]:
         """Fleet-aggregated prefix-reuse numbers (sums the replicas'

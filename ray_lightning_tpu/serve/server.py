@@ -146,6 +146,11 @@ class Server:
         self.failure_report: Optional[dict] = None
         self._setup_info: list = []
         self.telemetry_paths: Optional[dict] = None
+        #: goodput plane (telemetry/goodput.py): the pump's wall-clock
+        #: ledger (decode / prefill / queue_idle split) and its
+        #: finalized doc — the serve half of the goodput surface
+        self._goodput_ledger = None
+        self.goodput_doc: Optional[dict] = None
 
     @staticmethod
     def _resolve_weights(module, checkpoint: Optional[str]):
@@ -338,15 +343,31 @@ class Server:
             # item would be dropped silently
             from ray_lightning_tpu import telemetry
             telemetry.set_active(self._agg)
+        ledger = self._goodput_ledger = self._make_goodput_ledger()
+        try:
+            self._pump_iterations(sched, ledger)
+        finally:
+            self._finish_goodput()
+
+    def _pump_iterations(self, sched, ledger) -> None:
+        next_peek = time.monotonic() + 2.0
         while not self._stop.is_set():
             self._drain_queue()
             self._watchdog()
+            if ledger is not None and time.monotonic() >= next_peek:
+                # live /status: ship a mid-run peek of the open ledger
+                # (the finalized doc replaces it at pump exit)
+                self._ship_goodput(ledger.peek())
+                next_peek = time.monotonic() + 2.0
             plan = sched.plan()
             if plan is None:
                 if self._draining and sched.idle():
                     return
+                t_idle = time.monotonic()
                 self._work.wait(0.02)
                 self._work.clear()
+                if ledger is not None:
+                    ledger.add("queue_idle", time.monotonic() - t_idle)
                 continue
             if self._profile_ctl is not None:
                 # armed profile window rides the SAME broadcast as the
@@ -355,6 +376,7 @@ class Server:
                 pending = self._profile_ctl.take_pending()
                 if pending is not None:
                     plan["profile"] = pending
+            t_step = time.monotonic()
             try:
                 futures = [w.call("serve_step", plan)
                            for w in self._workers]
@@ -381,9 +403,63 @@ class Server:
                 self.failure_report = self._dump_flights(e)
                 sched.fail_all(e)
                 return
+            if ledger is not None:
+                # attribution rule: a dispatch that decodes produced
+                # tokens (useful); a prefill-only dispatch is context
+                # build — measured, but not goodput
+                step_s = time.monotonic() - t_step
+                if plan.get("decode") is not None:
+                    ledger.note_step(step_s)
+                else:
+                    ledger.add("prefill", step_s)
             sched.apply(plan, result)
             if self._profile_ctl is not None:
                 self._profile_ctl.note_step()
+
+    # -- goodput (telemetry/goodput.py) ------------------------------------
+
+    def _make_goodput_ledger(self):
+        """Open the serve-side wall-clock ledger when the plane is
+        armed: every pump second lands in decode / prefill /
+        queue_idle (residual → other; the router adds autoscale
+        actuation at the fleet level)."""
+        cfg = self.telemetry
+        if self._agg is None or not cfg.resolved_goodput():
+            return None
+        from ray_lightning_tpu.telemetry import goodput as _goodput
+        devices = self.num_workers * int(self.devices_per_worker or 1)
+        return _goodput.GoodputLedger(
+            "serve", device_tflops=cfg.resolved_goodput_tflops(),
+            devices=devices).start()
+
+    def _ship_goodput(self, doc: dict) -> None:
+        if self._agg is None or not doc:
+            return
+        from ray_lightning_tpu.telemetry import goodput as _goodput
+        try:
+            self._agg.ingest_goodput(_goodput.goodput_item(0, doc))
+        except Exception:
+            _log.debug("serve goodput ingest failed", exc_info=True)
+
+    def _finish_goodput(self) -> None:
+        ledger = self._goodput_ledger
+        if ledger is None:
+            return
+        self._goodput_ledger = None
+        from ray_lightning_tpu.telemetry import goodput as _goodput
+        self.goodput_doc = doc = ledger.finalize()
+        self._ship_goodput(doc)
+        _goodput.publish_metrics(doc)
+
+    def goodput(self) -> Optional[dict]:
+        """This replica's goodput doc: the finalized partition after
+        the pump exits, a live peek while it runs, None when the plane
+        is disarmed.  The fleet router aggregates these
+        (serve/fleet/router.py)."""
+        if self.goodput_doc is not None:
+            return self.goodput_doc
+        ledger = self._goodput_ledger
+        return ledger.peek() if ledger is not None else None
 
     def _dump_flights(self, error: BaseException) -> dict:
         """Per-rank ``flight_<rank>.json`` dumps for a mid-serve fleet
@@ -448,6 +524,9 @@ class Server:
         hits) in one dict."""
         out = {"scheduler": self.scheduler.stats(),
                "setup": self._setup_info}
+        gp = self.goodput()
+        if gp:
+            out["goodput"] = gp
         if self.failure_report is not None:
             out["failure"] = self.failure_report
         if self._started and self._workers:

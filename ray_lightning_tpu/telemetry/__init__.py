@@ -66,6 +66,15 @@ from ray_lightning_tpu.telemetry.anatomy import (  # noqa: F401
     parse_anatomy_or_none,
     parse_trace_anatomy,
 )
+from ray_lightning_tpu.telemetry.goodput import (  # noqa: F401
+    GoodputLedger,
+    disable_goodput,
+    enable_goodput,
+    finish_run,
+    goodput_item,
+    measured_mfu,
+    start_run,
+)
 from ray_lightning_tpu.telemetry.metrics import (  # noqa: F401
     MetricsRegistry,
     disable_metrics,
@@ -115,6 +124,13 @@ __all__ = [
     "note_step_collectives",
     "on_step",
     "on_compile",
+    "GoodputLedger",
+    "enable_goodput",
+    "disable_goodput",
+    "start_run",
+    "finish_run",
+    "goodput_item",
+    "measured_mfu",
     "StepAnatomy",
     "AnatomyController",
     "anatomy_item",
@@ -166,6 +182,13 @@ class TelemetryConfig:
     anatomy_every_n_steps: Optional[int] = None
     #: dispatches traced per anatomy window
     anatomy_steps: int = 4
+    #: goodput plane (telemetry/goodput.py): the per-run wall-clock
+    #: partition + measured MFU.  None = armed whenever telemetry is
+    #: enabled unless RLT_GOODPUT=0 disarms; an explicit bool wins
+    goodput: Optional[bool] = None
+    #: per-device peak TFLOPs for the MFU denominator; None defers to
+    #: RLT_GOODPUT_TFLOPS, then PlanConfig.device_tflops
+    goodput_tflops: Optional[float] = None
 
     @classmethod
     def resolve(cls, value: Any) -> "TelemetryConfig":
@@ -234,16 +257,51 @@ class TelemetryConfig:
             every = None
         return every, max(1, int(steps))
 
+    def resolved_goodput(self) -> bool:
+        """Is the goodput ledger armed?  The explicit config bool wins;
+        None defers to ``RLT_GOODPUT`` (unset = armed — goodput rides
+        telemetry by default, so arming telemetry is opting in)."""
+        if self.goodput is not None:
+            return bool(self.goodput)
+        from ray_lightning_tpu.telemetry import goodput as _goodput
+        return _goodput.goodput_armed()
+
+    def resolved_goodput_tflops(self) -> Optional[float]:
+        """Per-device peak TFLOPs for MFU: the explicit config field,
+        else ``RLT_GOODPUT_TFLOPS``, else None (the trainer falls back
+        to ``PlanConfig.device_tflops``)."""
+        if self.goodput_tflops is not None:
+            return float(self.goodput_tflops)
+        from ray_lightning_tpu.telemetry import goodput as _goodput
+        env = os.environ.get(_goodput.GOODPUT_TFLOPS_ENV, "").strip()
+        if env:
+            try:
+                return float(env)
+            except ValueError:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "%s=%r is not a number; ignored",
+                    _goodput.GOODPUT_TFLOPS_ENV, env)
+        return None
+
     def worker_env(self) -> dict:
         """Env knobs actor fleets must inherit so every rank arms the
-        same anatomy cadence the driver resolved (ships in the plugin's
-        base worker env like the RLT_COMM*/RLT_PLAN* knobs)."""
+        same anatomy cadence and goodput plane the driver resolved
+        (ships in the plugin's base worker env like the
+        RLT_COMM*/RLT_PLAN* knobs)."""
         from ray_lightning_tpu.telemetry import anatomy as _anatomy
+        from ray_lightning_tpu.telemetry import goodput as _goodput
+        out = {}
         every, steps = self.resolved_anatomy()
-        if every is None:
-            return {}
-        return {_anatomy.ANATOMY_EVERY_ENV: str(every),
-                _anatomy.ANATOMY_STEPS_ENV: str(steps)}
+        if every is not None:
+            out[_anatomy.ANATOMY_EVERY_ENV] = str(every)
+            out[_anatomy.ANATOMY_STEPS_ENV] = str(steps)
+        if not self.resolved_goodput():
+            out[_goodput.GOODPUT_ENV] = "0"
+        tflops = self.resolved_goodput_tflops()
+        if tflops is not None:
+            out[_goodput.GOODPUT_TFLOPS_ENV] = str(tflops)
+        return out
 
     def resolve_dir(self, default_root_dir: str) -> str:
         if self.dir:

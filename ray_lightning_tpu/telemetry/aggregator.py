@@ -130,6 +130,13 @@ class TelemetryAggregator:
         #: elastic attempt (parity | replay | scratch — elastic/driver)
         self._recovery_mode: Optional[str] = None
         self._recovery_seconds: Optional[float] = None
+        #: goodput plane (telemetry/goodput.py): latest finalized run
+        #: ledger per rank + the driver-side recovery attribution that
+        #: folds into the fleet aggregate (replayed steps become the
+        #: ``replay`` badput bucket, decision seconds the ``recovery``
+        #: bucket)
+        self._goodput_latest: dict[int, dict] = {}
+        self._replayed_steps = 0
 
     # -- ingestion -------------------------------------------------------
 
@@ -150,7 +157,49 @@ class TelemetryAggregator:
             self.ingest_metrics(item)
         elif kind == "anatomy":
             self.ingest_anatomy(item)
+        elif kind == "goodput":
+            self.ingest_goodput(item)
         return True
+
+    def ingest_goodput(self, item: dict) -> None:
+        """One rank's finalized run-ledger doc (telemetry/goodput.py):
+        keep the latest per rank for /status + the export summary, and
+        mirror a brief into the flight recorder so a crash's black box
+        says where THAT rank's run wall was going."""
+        rank = item.get("rank", -1)
+        doc = item.get("goodput") or {}
+        with self._lock:
+            self._goodput_latest[rank] = dict(doc)
+        self.flight.note_goodput(rank, doc)
+
+    def set_replayed_steps(self, n: int) -> None:
+        """Steps the resumed attempt re-executed after a snapshot-replay
+        recovery (elastic/driver.py) — re-attributed from the fleet
+        aggregate's ``step`` bucket into ``replay`` badput."""
+        with self._lock:
+            self._replayed_steps = max(0, int(n))
+
+    def goodput_stats(self) -> dict:
+        """Per-rank run-ledger docs + the fleet aggregate (identity
+        ``sum(buckets) == run_wall`` holds on both levels) — the
+        ``goodput`` section of /status and the export summary."""
+        from ray_lightning_tpu.telemetry import goodput as _goodput
+        with self._lock:
+            latest = {r: dict(d)
+                      for r, d in sorted(self._goodput_latest.items())}
+            replayed = self._replayed_steps
+            rec_s = self._recovery_seconds
+        if not latest:
+            return {}
+        docs = list(latest.values())
+        extra = {}
+        if rec_s and docs[0].get("kind") == "fit":
+            extra["recovery"] = float(rec_s)
+        fleet = _goodput.aggregate(docs, extra_buckets=extra)
+        if replayed and fleet:
+            fleet = _goodput.reattribute_replay(fleet, replayed)
+        return {"per_rank": {str(r): d for r, d in latest.items()},
+                "fleet": fleet}
 
     def ingest_anatomy(self, item: dict) -> None:
         """One rank's compact step anatomy (telemetry/anatomy.py): keep
@@ -254,12 +303,14 @@ class TelemetryAggregator:
             self.note_worker_alive(rank, bool(alive))
 
     def _driver_metrics(self) -> list[dict]:
+        goodput = self.goodput_stats()
         with self._lock:
             fleet = dict(self._fleet_alive)
             restarts = self._restarts
             rec_mode = self._recovery_mode
             rec_s = self._recovery_seconds
-        if not fleet and not restarts and rec_mode is None:
+        if not fleet and not restarts and rec_mode is None \
+                and not goodput:
             return []
         out = [{"name": "rlt_worker_alive", "type": "gauge",
                 "labels": {"worker": str(rank)}, "value": v}
@@ -273,6 +324,22 @@ class TelemetryAggregator:
                 out.append({"name": "rlt_recovery_seconds",
                             "type": "gauge", "labels": {},
                             "value": rec_s})
+        fleet_gp = (goodput or {}).get("fleet") or {}
+        if fleet_gp:
+            kind = fleet_gp.get("kind", "fit")
+            for bucket, seconds in (fleet_gp.get("buckets") or {}).items():
+                out.append({"name": "rlt_goodput_seconds",
+                            "type": "gauge",
+                            "labels": {"bucket": bucket, "kind": kind,
+                                       "scope": "fleet"},
+                            "value": seconds})
+            out.append({"name": "rlt_goodput_fraction", "type": "gauge",
+                        "labels": {"kind": kind, "scope": "fleet"},
+                        "value": fleet_gp.get("goodput_fraction", 0.0)})
+            if fleet_gp.get("mfu") is not None:
+                out.append({"name": "rlt_mfu", "type": "gauge",
+                            "labels": {"scope": "fleet"},
+                            "value": fleet_gp["mfu"]})
         return out
 
     def fleet_health(self) -> dict[int, int]:
@@ -702,6 +769,17 @@ class TelemetryAggregator:
             # measured step-time truth (telemetry/anatomy.py): where
             # device time went per rank, from real profiler captures
             summary["anatomy"] = anatomy
+        goodput = self.goodput_stats()
+        if goodput:
+            # run-time truth (telemetry/goodput.py): the full-run
+            # wall-clock partition + measured MFU, per rank and fleet
+            summary["goodput"] = goodput
+            fleet_gp = goodput.get("fleet") or {}
+            # scalar conveniences for bench JSON lines / quick greps
+            if "goodput_fraction" in fleet_gp:
+                summary["goodput_fraction"] = fleet_gp["goodput_fraction"]
+            if fleet_gp.get("mfu") is not None:
+                summary["mfu"] = fleet_gp["mfu"]
         collectives = self.collective_stats()
         hbm = self.hbm_stats()
         dropped = self.dropped_stats()

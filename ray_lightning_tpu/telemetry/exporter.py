@@ -115,6 +115,12 @@ def render_status(aggregator, profile_controller=None) -> dict:
         # by op and ici/dcn link) parsed from real profiler captures on
         # the ranks themselves, plus straggler skew on measured wall
         doc["anatomy"] = anatomy
+    goodput = aggregator.goodput_stats()
+    if goodput:
+        # goodput plane (telemetry/goodput.py): the full-run wall-clock
+        # partition (sum(buckets) == run_wall exactly) + measured MFU,
+        # per rank and fleet-aggregated
+        doc["goodput"] = goodput
     tenants = aggregator.tenant_breakdown()
     if tenants:
         # per-request trace plane: TTFT/TPOT with queue vs prefill vs

@@ -60,6 +60,7 @@ class FlightRecorder:
         self._beats: dict[int, deque] = {}
         self._briefs: dict[int, dict] = {}
         self._anatomy: dict[int, dict] = {}
+        self._goodput: dict[int, dict] = {}
         #: rank -> path of the last dump (status/test surface)
         self.dumped: dict[int, str] = {}
 
@@ -92,6 +93,12 @@ class FlightRecorder:
         if anatomy:
             self._anatomy[rank] = dict(anatomy)
 
+    def note_goodput(self, rank: int, doc: Optional[dict]) -> None:
+        """Latest run-ledger doc (telemetry/goodput.py) — the black box
+        then carries the rank's wall-clock partition up to the crash."""
+        if doc:
+            self._goodput[rank] = dict(doc)
+
     # -- evidence surface ------------------------------------------------
 
     def last_spans(self, rank: int) -> list[dict]:
@@ -119,6 +126,7 @@ class FlightRecorder:
             "last_heartbeat_wall": beats[-1]["wall"] if beats else None,
             "metrics_brief": self._briefs.get(rank),
             "anatomy": self._anatomy.get(rank),
+            "goodput": self._goodput.get(rank),
             "capacity": {"spans": self.span_capacity,
                          "heartbeats": self.beat_capacity},
         }

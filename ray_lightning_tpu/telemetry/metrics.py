@@ -55,7 +55,8 @@ UNIT_SUFFIXES = ("_bytes", "_seconds", "_total")
 #: unitless boolean gauges (Prometheus "up"-style) explicitly exempt
 #: from the unit-suffix rule — a 0/1 liveness verdict has no unit to
 #: carry.  Keep this list short and deliberate.
-UNITLESS_GAUGES = ("rlt_worker_alive", "rlt_recovery_mode")
+UNITLESS_GAUGES = ("rlt_worker_alive", "rlt_recovery_mode",
+                   "rlt_goodput_fraction", "rlt_mfu")
 
 #: step-time histogram bounds (seconds): sub-ms dispatch latency up to
 #: multi-second giant-model steps
@@ -128,6 +129,12 @@ CORE_METRICS = (
     "rlt_recovery_seconds",
     # peer-channel retry trail (cluster/peer.py bounded backoff)
     "rlt_peer_retries_total",
+    # goodput plane (telemetry/goodput.py): the run-wall partition per
+    # bucket, the useful fraction, and measured MFU — per rank from the
+    # worker registries, fleet-aggregated as driver (rank -1) series
+    "rlt_goodput_seconds",
+    "rlt_goodput_fraction",
+    "rlt_mfu",
     # MPMD plane (mpmd/engine.py): simulated bubble seconds/step per
     # schedule, set once per fit from the measured per-op replay
     "rlt_mpmd_bubble_seconds",

@@ -21,7 +21,13 @@ that would otherwise only fail deep inside a live fleet:
    synthetic fixture — overlap math (fully-overlapped → ~0 exposed,
    serialized → exposed ≈ collective), the wall = compute + exposed +
    host identity, the compact-dict schema — plus the TelemetryConfig
-   anatomy knobs round-tripping through ``worker_env`` / RLT_ANATOMY*.
+   anatomy knobs round-tripping through ``worker_env`` / RLT_ANATOMY*;
+7. goodput plane (telemetry/goodput.py): the partition is exhaustive
+   and disjoint per kind, ``sum(buckets) == run_wall`` holds on a
+   synthetic ledger (including the overshoot-scaling path, replay
+   reattribution and fleet aggregation), the ``rlt_goodput_*`` /
+   ``rlt_mfu`` names are Prometheus-clean, and the RLT_GOODPUT* knobs
+   round-trip through ``worker_env``.
 """
 
 from __future__ import annotations
@@ -223,6 +229,112 @@ def _check_anatomy_config_roundtrip() -> None:
           "worker_env/RLT_ANATOMY* OK")
 
 
+def _check_goodput_partition() -> None:
+    """Goodput-plane invariants (telemetry/goodput.py): the partition
+    is exhaustive + disjoint per kind, the ``sum(buckets) == run_wall``
+    identity holds on a synthetic ledger — including the overshoot
+    path, where instrumented time exceeds the wall and every bucket
+    scales down — and replay reattribution moves seconds without
+    touching the wall."""
+    from ray_lightning_tpu.telemetry import goodput as gp
+
+    # partition shape: one useful bucket per kind, 'other' residual,
+    # no duplicates, no cross-kind leakage of fit-only buckets
+    for kind, buckets in gp.BUCKETS.items():
+        assert len(set(buckets)) == len(buckets), f"{kind}: dup bucket"
+        assert "other" in buckets, f"{kind}: no residual bucket"
+        assert gp.USEFUL_BUCKET[kind] in buckets
+    assert "replay" not in gp.SERVE_BUCKETS
+    assert "decode" not in gp.FIT_BUCKETS
+
+    # identity on a synthetic fit ledger (controlled clock)
+    t = [0.0]
+    ledger = gp.GoodputLedger("fit", device_tflops=100.0, devices=4,
+                              clock=lambda: t[0]).start()
+    ledger.add("compile", 2.0)
+    ledger.add("init", 0.5)
+    for _ in range(10):
+        ledger.note_step(0.3)
+    ledger.add("data_wait", 0.2)
+    ledger.set_flops_per_step(6e12)
+    t[0] = 8.0
+    doc = ledger.finalize()
+    assert gp.check_identity(doc), doc
+    assert doc["buckets"]["step"] == 3.0 and doc["steps"] == 10
+    assert abs(doc["buckets"]["other"] - 2.3) < 1e-9
+    assert doc["mfu"] is not None and 0 < doc["mfu"] < 1
+
+    # overshoot: instrumented 6s against a 3s wall still closes exactly
+    over = gp.GoodputLedger("serve")
+    over.note_step(4.0)
+    over.add("prefill", 2.0)
+    doc = over.finalize(3.0)
+    assert gp.check_identity(doc), doc
+    assert abs(doc["buckets"]["decode"] - 2.0) < 1e-9
+
+    # replay reattribution: seconds move step->replay, wall untouched
+    fit = gp.GoodputLedger("fit")
+    for _ in range(10):
+        fit.note_step(0.5)
+    doc = fit.finalize(6.0)
+    re = gp.reattribute_replay(doc, 4)
+    assert gp.check_identity(re), re
+    assert abs(re["buckets"]["replay"] - 2.0) < 1e-9
+    assert re["run_wall_s"] == doc["run_wall_s"]
+
+    # fleet aggregation: extra buckets extend wall AND bucket
+    agg = gp.aggregate([doc, doc], extra_buckets={"recovery": 1.5})
+    assert gp.check_identity(agg), agg
+    assert abs(agg["buckets"]["recovery"] - 1.5) < 1e-9
+    print("telemetry selfcheck: goodput partition exhaustive+disjoint, "
+          "identity holds (incl. overshoot + replay + aggregate)")
+
+
+def _check_goodput_metric_names() -> None:
+    from ray_lightning_tpu.telemetry.metrics import (
+        CORE_METRICS,
+        validate_metric_name,
+    )
+    names = ("rlt_goodput_seconds", "rlt_goodput_fraction", "rlt_mfu")
+    assert set(names) <= set(CORE_METRICS), "goodput gauges not core"
+    for name in names:
+        validate_metric_name(name)
+    print("telemetry selfcheck: goodput metric names Prometheus-clean")
+
+
+def _check_goodput_config_roundtrip() -> None:
+    """TelemetryConfig goodput knobs → worker_env → env resolution."""
+    import os
+    from ray_lightning_tpu.telemetry import TelemetryConfig, goodput
+
+    saved = {k: os.environ.get(k) for k in
+             (goodput.GOODPUT_ENV, goodput.GOODPUT_TFLOPS_ENV)}
+    try:
+        for k in saved:
+            os.environ.pop(k, None)
+        # default: armed, no env emitted (worker_env stays minimal)
+        cfg = TelemetryConfig()
+        assert cfg.resolved_goodput() is True
+        assert goodput.GOODPUT_ENV not in cfg.worker_env()
+        # explicit disarm ships RLT_GOODPUT=0 and the worker resolves it
+        cfg = TelemetryConfig(goodput=False, goodput_tflops=275.0)
+        env = cfg.worker_env()
+        assert env[goodput.GOODPUT_ENV] == "0"
+        assert env[goodput.GOODPUT_TFLOPS_ENV] == "275.0"
+        os.environ.update(env)
+        worker = TelemetryConfig()
+        assert worker.resolved_goodput() is False
+        assert worker.resolved_goodput_tflops() == 275.0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    print("telemetry selfcheck: goodput config round-trip via "
+          "worker_env/RLT_GOODPUT* OK")
+
+
 def _main(argv: list) -> int:
     _check_span_schema()
     _check_trace_roundtrip()
@@ -231,6 +343,9 @@ def _main(argv: list) -> int:
     _check_metric_names()
     _check_anatomy_parser()
     _check_anatomy_config_roundtrip()
+    _check_goodput_partition()
+    _check_goodput_metric_names()
+    _check_goodput_config_roundtrip()
     return 0
 
 

@@ -291,6 +291,16 @@ class _FakeServer:
             "flight_paths": {0: "/tmp/flight_0.json"}}
         self.scheduler.fail_all(error)
 
+    def goodput(self):
+        """Synthetic finalized serve partition (telemetry/goodput.py)
+        so router-level tests exercise fleet goodput aggregation —
+        including the retired-replica fold — without an engine."""
+        from ray_lightning_tpu.telemetry.goodput import GoodputLedger
+        led = GoodputLedger("serve")
+        led.note_step(1.0, k=4)
+        led.add("prefill", 0.25)
+        return led.finalize(2.0)
+
     def drain(self, timeout=None):
         deadline = time.monotonic() + (timeout or 10)
         while not self.scheduler.idle():
@@ -444,6 +454,25 @@ def test_autoscaler_grow_and_shrink_through_router():
         assert fleet.failed == 0 and fleet.completed == 10
         # late requests still served after the shrink
         assert len(fleet.generate(np.arange(1, 4), timeout=10)) == 3
+
+        # fleet goodput (telemetry/goodput.py): the reaped replica's
+        # finalized doc is preserved next to the survivor's live peek,
+        # and the autoscaler's actuation seconds extend the wall as
+        # their own bucket — the identity holds on the aggregate by
+        # construction
+        from ray_lightning_tpu.telemetry.goodput import check_identity
+        gp = fleet.goodput_stats()
+        assert gp["kind"] == "serve" and gp["ranks"] >= 2
+        assert check_identity(gp), gp
+        assert gp["buckets"]["decode"] == pytest.approx(1.0 * gp["ranks"])
+        # actuation seconds land in their own bucket (fake replicas
+        # actuate in sub-ms, so the rounded event sum may be 0.0 —
+        # equality, not >0, is the contract here)
+        actuation = sum(e["seconds"] or 0.0
+                        for e in fleet.autoscaler.stats()["events"])
+        assert gp["buckets"]["autoscale"] == pytest.approx(
+            actuation, abs=1e-6)
+        assert fleet.status()["fleet"]["goodput"]["ranks"] == gp["ranks"]
     finally:
         fleet.shutdown()
 

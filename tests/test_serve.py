@@ -546,6 +546,20 @@ def test_e2e_two_workers_multi_tenant_live_metrics(tmp_path, seed,
         server.shutdown()
     assert server.telemetry_paths and "metrics" in server.telemetry_paths
 
+    # -- goodput plane (telemetry/goodput.py): the pump's finalized
+    # wall partition closes exactly on a REAL serve run — decode
+    # (useful, token-producing) vs prefill-only dispatch vs queue
+    # idling, with the live /status twin carried by stats()
+    from ray_lightning_tpu.telemetry.goodput import check_identity
+    gp = server.goodput()
+    assert gp is not None and gp["kind"] == "serve"
+    assert check_identity(gp), gp
+    assert gp["buckets"]["decode"] > 0
+    assert gp["buckets"]["prefill"] > 0
+    assert gp["buckets"]["queue_idle"] > 0
+    assert gp["steps"] > 0 and 0 < gp["goodput_fraction"] < 1
+    assert stats["goodput"]["kind"] == "serve"
+
     # -- compiled once per fleet, ever: a RESTARTED fleet on the same
     # cache dir warm-starts from the first fleet's disk entries —
     # compile-cache hit counters prove it.  Upstream jax only writes
