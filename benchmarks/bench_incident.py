@@ -1,0 +1,65 @@
+"""Incident-plane detector overhead: the same fit A/B'd with the
+incident plane OFF then ON (``RLT_INCIDENT``), reported as one
+``incident_ab`` record the perf ledger gates ABSOLUTELY at 2%
+(benchmarks/ledger.py ``incident_band``).
+
+The incident plane is always-on telemetry — timelines fed from every
+span batch, a detector ticked per sample, the heartbeat sample tail —
+so its cost rides every training step of every run.  A relative
+round-over-round band can't see that cost (it is identical on both
+sides); this leg measures it directly by differencing steps/sec with
+the plane disabled vs enabled on an otherwise identical fit.
+
+    python -m benchmarks.bench_incident
+"""
+
+import json
+import os
+
+import jax
+
+from benchmarks.harness import run_steps_per_sec
+
+
+def _leg(enabled: bool, platform: str, batch: int) -> dict:
+    from ray_lightning_tpu.models import LightningMNISTClassifier
+    from ray_lightning_tpu.telemetry import incident
+
+    # dispatch-bound MLP: per-step framework overhead dominates, which
+    # is exactly the regime where detector cost would show
+    module = LightningMNISTClassifier(config={"batch_size": batch},
+                                      train_size=batch * 40)
+    prev = os.environ.get(incident.INCIDENT_ENV)
+    os.environ[incident.INCIDENT_ENV] = "1" if enabled else "0"
+    try:
+        return run_steps_per_sec(
+            module,
+            f"incident_{'on' if enabled else 'off'}_b{batch}"
+            f"_steps_per_sec_{platform}",
+            timed=100)
+    finally:
+        if prev is None:
+            os.environ.pop(incident.INCIDENT_ENV, None)
+        else:
+            os.environ[incident.INCIDENT_ENV] = prev
+
+
+def main():
+    platform = jax.devices()[0].platform
+    batch = 128
+    off = _leg(False, platform, batch)
+    on = _leg(True, platform, batch)
+    overhead_pct = round(
+        (off["value"] - on["value"]) / off["value"] * 100, 2)
+    print(json.dumps({
+        "metric": f"incident_overhead_b{batch}_{platform}",
+        "incident_ab": {
+            "steps_per_sec_off": off["value"],
+            "steps_per_sec_on": on["value"],
+            "overhead_pct": overhead_pct,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,12 @@ figure                          worse    band
 ``measured_bubble_fraction_*``  higher  ``goodput_band`` + the same
                                         absolute floor (bench_pipeline
                                         1f1b/gpipe audit)
+``incident_ab.overhead_pct``    higher  ``incident_band`` (default 2%,
+                                        ABSOLUTE: the current round's
+                                        incident-plane on-vs-off
+                                        steps/sec delta, gated even
+                                        without a previous round —
+                                        bench_incident.py A/B leg)
 ==============================  ======  ==============================
 
 Improvements are reported too (the ledger is a trajectory, not just an
@@ -77,6 +83,10 @@ GOODPUT_BAND = 0.10
 #: absolute goodput-fraction / bubble-fraction floor: drift smaller
 #: than 2 points of fraction is wall-clock noise, not a regression
 MIN_GOODPUT_DELTA = 0.02
+#: detector-overhead ceiling (telemetry/incident.py): the incident
+#: plane runs on every fit, so its measured on-vs-off step-wall cost
+#: (benchmarks/bench_incident.py) is gated ABSOLUTELY at 2%
+INCIDENT_BAND = 0.02
 
 
 def _iter_records(obj: Any) -> Iterable[dict]:
@@ -136,6 +146,7 @@ def compare(prev: Any, curr: Any, *, step_band: float = STEP_BAND,
             exposed_band: float = EXPOSED_BAND,
             serve_band: float = SERVE_BAND,
             goodput_band: float = GOODPUT_BAND,
+            incident_band: float = INCIDENT_BAND,
             min_exposed_s: float = MIN_EXPOSED_S,
             min_ttft_ms: float = MIN_TTFT_MS) -> dict:
     """Compare two rounds; the returned report's ``ok`` is the gate.
@@ -221,6 +232,24 @@ def compare(prev: Any, curr: Any, *, step_band: float = STEP_BAND,
             if p.get(fig) is not None or c.get(fig) is not None:
                 check(metric, fig, p.get(fig), c.get(fig), "higher",
                       goodput_band, floor=MIN_GOODPUT_DELTA)
+    # incident-plane detector overhead (bench_incident.py A/B leg):
+    # an ABSOLUTE gate on the CURRENT round — the measured incident
+    # on-vs-off steps/sec delta must stay within incident_band even
+    # when the previous round has no such leg (overhead that merely
+    # holds steady at 5% is still a broken contract)
+    for metric in sorted(curr_by):
+        ia = curr_by[metric].get("incident_ab")
+        if not isinstance(ia, dict) or ia.get("overhead_pct") is None:
+            continue
+        compared += 1
+        pct = float(ia["overhead_pct"])
+        row = {"metric": metric, "figure": "incident_ab.overhead_pct",
+               "prev": ia.get("steps_per_sec_off"),
+               "curr": ia.get("steps_per_sec_on"),
+               "delta_pct": round(pct, 2),
+               "note": "absolute gate: incident plane on vs off"}
+        if pct > incident_band * 100:
+            regressions.append(row)
     report = {
         "metric": "perf_ledger",
         "compared": compared,
@@ -231,6 +260,7 @@ def compare(prev: Any, curr: Any, *, step_band: float = STEP_BAND,
         "only_curr": sorted(set(curr_by) - set(prev_by)),
         "bands": {"step": step_band, "exposed": exposed_band,
                   "serve": serve_band, "goodput": goodput_band,
+                  "incident": incident_band,
                   "min_exposed_s": min_exposed_s,
                   "min_ttft_ms": min_ttft_ms,
                   "min_goodput_delta": MIN_GOODPUT_DELTA},
@@ -261,11 +291,17 @@ def main(argv: list) -> int:
                         help="relative band for goodput fraction, MFU "
                         "and measured bubble fractions "
                         f"(default {GOODPUT_BAND})")
+    parser.add_argument("--incident-band", type=float,
+                        default=INCIDENT_BAND,
+                        help="ABSOLUTE ceiling on the incident plane's "
+                        "measured on-vs-off steps/sec overhead "
+                        f"(default {INCIDENT_BAND})")
     args = parser.parse_args(argv)
     report = compare(args.prev, args.curr, step_band=args.step_band,
                      exposed_band=args.exposed_band,
                      serve_band=args.serve_band,
-                     goodput_band=args.goodput_band)
+                     goodput_band=args.goodput_band,
+                     incident_band=args.incident_band)
     print(json.dumps(report))
     return 0 if report["ok"] else 1
 

@@ -162,3 +162,78 @@ def calibrated_gbps(cache_dir: Optional[str] = None,
         _log.warning("bandwidth calibration failed (%r); using the "
                      "audit constants", e)
         return ICI_GBPS, DCN_GBPS
+
+
+# -- live (anatomy-measured) calibration ----------------------------------
+#
+# The microbench above measures an idealized standalone all-reduce.  A
+# real fit's anatomy window (telemetry/anatomy.py) measures the exposed
+# comm of the ACTUAL step program — overlap, fusion boundaries and all.
+# The ratio of measured exposed to the planner's modeled comm seconds is
+# a per-topology correction factor (``comm_scale``): the trainer writes
+# it at the end of every instrumented run
+# (core/trainer.py _attach_observed_divergence), and
+# ``RLT_PLAN_CALIBRATE=live`` divides the link constants by it so the
+# NEXT plan's byte→seconds model starts from what the fabric actually
+# delivered (ROADMAP 5(a) leg).
+
+#: sane bounds on the correction: outside this the anatomy window was
+#: degenerate (empty modeled comm, or a pathological capture) and the
+#: sample is discarded rather than poisoning the next plan
+LIVE_SCALE_BOUNDS = (0.1, 10.0)
+
+
+def live_cache_path(cache_dir: Optional[str] = None) -> str:
+    return os.path.join(_cache_dir(cache_dir),
+                        f"live_{topology_fingerprint()}.json")
+
+
+def save_live_calibration(step_wall_s: float, exposed_comm_s: float,
+                          modeled_comm_s: Optional[float],
+                          cache_dir: Optional[str] = None
+                          ) -> Optional[str]:
+    """Persist one run's measured-vs-modeled comm correction, keyed by
+    topology fingerprint.  Returns the path, or None when the sample is
+    unusable (no modeled comm, out-of-bounds ratio, any failure) — a
+    bad window must never poison the next plan."""
+    try:
+        if not modeled_comm_s or float(modeled_comm_s) <= 0:
+            return None
+        scale = float(exposed_comm_s) / float(modeled_comm_s)
+        if not (LIVE_SCALE_BOUNDS[0] <= scale <= LIVE_SCALE_BOUNDS[1]):
+            _log.info("live calibration sample discarded: comm_scale "
+                      "%.3f outside %s", scale, LIVE_SCALE_BOUNDS)
+            return None
+        path = live_cache_path(cache_dir)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        doc = {
+            "fingerprint": topology_fingerprint(),
+            "comm_scale": round(scale, 4),
+            "step_wall_s": round(float(step_wall_s), 6),
+            "exposed_comm_s": round(float(exposed_comm_s), 6),
+            "modeled_comm_s": round(float(modeled_comm_s), 6),
+            "ts": time.time(),
+        }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        _log.info("live comm calibration: measured/modeled = %.3f -> %s",
+                  scale, path)
+        return path
+    except Exception:   # noqa: BLE001 - calibration never raises
+        _log.debug("live calibration write failed", exc_info=True)
+        return None
+
+
+def live_calibration(cache_dir: Optional[str] = None) -> Optional[dict]:
+    """The stored live correction for THIS topology, or None."""
+    try:
+        with open(live_cache_path(cache_dir)) as f:
+            doc = json.load(f)
+        scale = float(doc["comm_scale"])
+        if not (LIVE_SCALE_BOUNDS[0] <= scale <= LIVE_SCALE_BOUNDS[1]):
+            return None
+        return doc
+    except Exception:   # noqa: BLE001 - missing/corrupt = no correction
+        return None

@@ -546,6 +546,23 @@ class Trainer:
             stats = self._snapshotter.stats
             ledger.add("snapshot", stats.get("save_seconds", 0.0))
             ledger.add("snapshot_stall", stats.get("stall_seconds", 0.0))
+            try:
+                from ray_lightning_tpu import telemetry as _telemetry
+                agg = _telemetry.get_active()
+                if agg is not None and stats.get("snapshots"):
+                    # incident-plane correlation events: a snapshot (and
+                    # any stall it exposed on the step path) is a named
+                    # cause candidate, not background noise
+                    agg.note_event("snapshot",
+                                   saves=int(stats.get("snapshots", 0)),
+                                   seconds=round(
+                                       stats.get("save_seconds", 0.0), 6))
+                    if stats.get("stall_seconds", 0.0) > 0:
+                        agg.note_event("snapshot_stall",
+                                       seconds=round(
+                                           stats["stall_seconds"], 6))
+            except Exception:
+                pass
         try:
             from ray_lightning_tpu.telemetry import anatomy as _anatomy
             ctl = _anatomy.get_anatomy_controller()
@@ -592,6 +609,22 @@ class Trainer:
                       if modeled_comm else None),
         }
         report["observed"] = observed
+        try:
+            # live calibration (ROADMAP 5(a) leg): persist the measured
+            # vs modeled comm ratio so the NEXT plan under
+            # RLT_PLAN_CALIBRATE=live ranks with corrected bandwidths
+            from ray_lightning_tpu.comm.calibrate import (
+                save_live_calibration)
+            save_live_calibration(step_wall, exposed_comm, modeled_comm)
+        except Exception:
+            pass
+        try:
+            # divergence past the band = the plan's model no longer
+            # describes this run: a replan-recommended incident verdict
+            # (telemetry/incident.py note_divergence)
+            agg.incidents.note_divergence(observed)
+        except Exception:
+            pass
 
     # -- data -----------------------------------------------------------
 
@@ -745,6 +778,17 @@ class Trainer:
                 _log.info("plan: remat policy %r applied (module "
                           "default was %r)", remat_pick, spec.default)
         _log.info("plan: %s", report.summary())
+        try:
+            from ray_lightning_tpu import telemetry as _telemetry
+            agg = _telemetry.get_active()
+            if agg is not None:
+                # incident-plane correlation event: a (re-)plan is a
+                # step-time discontinuity with a name
+                agg.note_event("plan", winner=d.get("winner"),
+                               seconds=round(d.get("plan_seconds", 0.0),
+                                             6))
+        except Exception:
+            pass
         reg = _metrics.get_registry()
         if reg is not None:
             reg.gauge("rlt_plan_candidates_total").set(d["enumerated"])

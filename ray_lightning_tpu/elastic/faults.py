@@ -10,9 +10,11 @@ what happens after.  A :class:`FaultSpec` names one fault:
 - ``wedge:rank=K,step=S`` — the rank stops making progress WITHOUT
   dying (sleeps forever; the connection stays open, so only the
   heartbeat watchdog can name it);
-- ``slow:rank=K,step=S[,seconds=T]`` — the rank stalls ``T`` seconds
-  on every step from ``S`` on (a straggler, visible as skew in the
-  telemetry summary);
+- ``slow:rank=K,step=S[,seconds=T,count=N]`` — the rank stalls ``T``
+  seconds on every step from ``S`` on (a straggler, visible as skew in
+  the telemetry summary).  ``count=N`` (N > 1) bounds the straggler to
+  steps ``[S, S+N)`` so it CLEARS — the incident plane's open-then-
+  close path needs a fault with an end;
 - ``snapkill:rank=K,step=S[,code=C]`` — hard exit *mid-async-snapshot
   write*: fires inside ``Snapshotter.maybe_snapshot`` right after the
   orbax save is dispatched, so the step directory exists but never
@@ -95,11 +97,20 @@ class FaultSpec:
     def should_fire(self, rank: int, step: int,
                     restarts: int = 0) -> bool:
         """kill/wedge/snapkill/peerdrop fire once at the first step >=
-        ``step`` on the target rank; slow fires on every such step.
-        With ``restart=R`` set, only during elastic attempt R."""
+        ``step`` on the target rank; slow fires on every such step —
+        bounded to steps ``[step, step + count)`` when ``count > 1``,
+        so a straggler that CLEARS (the incident plane's close path)
+        is expressible; the ``count=1`` default keeps the legacy
+        unbounded straggler.  With ``restart=R`` set, only during
+        elastic attempt R."""
         if self.restart is not None and restarts != self.restart:
             return False
-        return rank == self.rank and step >= self.step
+        if rank != self.rank or step < self.step:
+            return False
+        if self.kind == "slow" and self.count > 1 \
+                and step >= self.step + self.count:
+            return False
+        return True
 
     def describe(self) -> str:
         extra = ""
@@ -107,6 +118,8 @@ class FaultSpec:
             extra = f",code={self.exit_code}"
         elif self.kind == "slow":
             extra = f",seconds={self.seconds}"
+            if self.count > 1:
+                extra += f",count={self.count}"
         elif self.kind == "peerdrop":
             extra = f",count={self.count}"
         if self.restart is not None:

@@ -14,8 +14,12 @@ pattern (first match wins):
   the Trainer arg is ``None``.
 - ``RLT_PLAN_CALIBRATE=1`` — replace the bandwidth constants with
   MEASURED link speeds (comm/calibrate.py: a tiny collective
-  microbench, run once and cached per topology fingerprint).  Explicit
-  ``RLT_PLAN_{ICI,DCN}_GBPS`` values still win.
+  microbench, run once and cached per topology fingerprint).
+  ``RLT_PLAN_CALIBRATE=live`` (or ``anatomy``) goes further: the last
+  instrumented run's anatomy-measured exposed-comm vs modeled-comm
+  ratio scales the constants (comm/calibrate.py live_calibration),
+  falling back to the microbench when no live sample exists yet.
+  Explicit ``RLT_PLAN_{ICI,DCN}_GBPS`` values still win.
 
 The resolved config pickles driver→worker on the Trainer and
 round-trips through ``worker_env()`` like the comm/compile/elastic
@@ -168,12 +172,28 @@ class PlanConfig:
         raw = os.environ.get(ENV_TOPK, "").strip()
         if raw:
             kw["topk"] = int(raw)
-        if os.environ.get(ENV_CALIBRATE, "").strip() in ("1", "true",
-                                                         "True"):
+        raw_cal = os.environ.get(ENV_CALIBRATE, "").strip().lower()
+        if raw_cal in ("1", "true"):
             # measured link bandwidths (cached per topology) replace
             # the constants; explicit RLT_PLAN_*_GBPS still win below
             from ray_lightning_tpu.comm.calibrate import calibrated_gbps
             kw["ici_gbps"], kw["dcn_gbps"] = calibrated_gbps()
+        elif raw_cal in ("live", "anatomy"):
+            # live anatomy calibration (ROADMAP 5(a) leg): the previous
+            # instrumented run's measured-exposed / modeled-comm ratio
+            # (comm/calibrate.py save_live_calibration) scales BOTH link
+            # constants — modeled comm seconds are linear in 1/gbps, so
+            # dividing by comm_scale makes the next plan's model match
+            # what the fabric delivered.  No stored sample yet falls
+            # back to the microbench path.
+            from ray_lightning_tpu.comm import calibrate as _cal
+            live = _cal.live_calibration()
+            if live is not None:
+                scale = float(live["comm_scale"])
+                kw["ici_gbps"] = round(_cal.ICI_GBPS / scale, 3)
+                kw["dcn_gbps"] = round(_cal.DCN_GBPS / scale, 3)
+            else:
+                kw["ici_gbps"], kw["dcn_gbps"] = _cal.calibrated_gbps()
         raw = os.environ.get(ENV_ICI, "").strip()
         if raw:
             kw["ici_gbps"] = float(raw)

@@ -54,7 +54,8 @@ class LocalPlugin(ExecutionPlugin):
             cfg.resolve_dir(trainer.default_root_dir),
             heartbeat_timeout=cfg.heartbeat_timeout,
             hard_timeout=cfg.hard_timeout,
-            flight_capacity=cfg.flight_capacity)
+            flight_capacity=cfg.flight_capacity,
+            incident_cfg=cfg.resolved_incident())
         telemetry.set_active(agg)
         telemetry.enable(rank=0, sink=lambda recs: agg.ingest_records(
             0, recs), capacity=cfg.capacity, flush_every=cfg.flush_every)
@@ -63,6 +64,19 @@ class LocalPlugin(ExecutionPlugin):
             # the run ledger inside _run_stage; arming here gives the
             # finalized doc a direct path onto the aggregator
             telemetry.enable_goodput(rank=0, sink=agg.maybe_ingest)
+        incident_env_set = False
+        if agg.incidents.cfg.enabled:
+            # incident plane arm channel: a detector trip writes this
+            # file; the AnatomyController (the "worker" is this
+            # process) polls it and forces an evidence window.  Set
+            # BEFORE enable_anatomy so the controller sees it.
+            from ray_lightning_tpu.telemetry import anatomy as _anatomy
+            inc_control = os.path.join(agg.out_dir, "incident",
+                                       "arm.json")
+            agg.incidents.arm_path = inc_control
+            if _anatomy.INCIDENT_CONTROL_ENV not in os.environ:
+                os.environ[_anatomy.INCIDENT_CONTROL_ENV] = inc_control
+                incident_env_set = True
         every_n, window = cfg.resolved_anatomy()
         if every_n is not None:
             # cadence-armed anatomy windows (telemetry/anatomy.py): the
@@ -100,6 +114,9 @@ class LocalPlugin(ExecutionPlugin):
             if profile_env_set:
                 os.environ.pop(tracing.PROFILE_CONTROL_ENV, None)
                 tracing.reset_profile_tick()
+            if incident_env_set:
+                from ray_lightning_tpu.telemetry import anatomy as _anatomy
+                os.environ.pop(_anatomy.INCIDENT_CONTROL_ENV, None)
             if server is not None:
                 server.stop()
             trainer._telemetry_paths = agg.export()

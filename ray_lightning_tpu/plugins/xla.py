@@ -333,7 +333,10 @@ class RayXlaPlugin(ExecutionPlugin):
         base_env = self._worker_env_base()
         cfg = trainer.telemetry
         profile_ctl = None
+        incident_cfg = None
+        incident_control = None
         if cfg.enabled:
+            incident_cfg = cfg.resolved_incident()
             # workers heartbeat from process start (worker_main) and
             # record spans once the fit payload arrives (_worker_run)
             base_env["RLT_TELEMETRY"] = "1"
@@ -354,6 +357,18 @@ class RayXlaPlugin(ExecutionPlugin):
                     "profile", "control.json")
                 profile_ctl = tracing.FileProfileController(control)
                 base_env[tracing.PROFILE_CONTROL_ENV] = control
+            if incident_cfg.enabled and getattr(
+                    backend, "shared_filesystem", False):
+                # incident-plane arm channel (telemetry/incident.py):
+                # on detector trip the driver writes this file; every
+                # rank's AnatomyController polls it and forces an
+                # off-cadence evidence window — same shared-FS idiom
+                # as the profile control file above
+                from ray_lightning_tpu.telemetry import anatomy as _anatomy
+                incident_control = os.path.join(
+                    cfg.resolve_dir(trainer.default_root_dir),
+                    "incident", "arm.json")
+                base_env[_anatomy.INCIDENT_CONTROL_ENV] = incident_control
         # persistent-compilation-cache knobs: the pickled trainer already
         # carries the config, but the env keeps worker-side tooling that
         # consults RLT_COMPILE_CACHE* (e.g. a nested fit) consistent.
@@ -417,7 +432,10 @@ class RayXlaPlugin(ExecutionPlugin):
                 cfg.resolve_dir(trainer.default_root_dir),
                 heartbeat_timeout=cfg.heartbeat_timeout,
                 hard_timeout=cfg.hard_timeout,
-                flight_capacity=cfg.flight_capacity)
+                flight_capacity=cfg.flight_capacity,
+                incident_cfg=incident_cfg)
+            if incident_control is not None:
+                agg.incidents.arm_path = incident_control
             # elastic restart count survives the per-attempt aggregator
             # rebuild so /metrics' rlt_restarts_total is cumulative,
             # and the recovery route the driver chose for THIS attempt

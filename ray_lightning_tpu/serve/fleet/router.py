@@ -304,7 +304,9 @@ class FleetServer:
             cfg.resolve_dir(self.default_root_dir),
             heartbeat_timeout=cfg.heartbeat_timeout,
             hard_timeout=cfg.hard_timeout,
-            flight_capacity=cfg.flight_capacity)
+            flight_capacity=cfg.flight_capacity,
+            incident_cfg=cfg.resolved_incident(),
+            run_kind="serve")
         self._agg = agg
         # ONE driver registry for the whole fleet: the router's
         # rlt_fleet_* gauges/counters and every replica scheduler's
@@ -593,11 +595,25 @@ class FleetServer:
         self._gauge("rlt_fleet_replicas_total", sig["replicas"])
         self._gauge("rlt_fleet_queue_depth_total", sig["queued"])
         self._gauge("rlt_fleet_active_slots_total", sig["active"])
+        if self._agg is not None:
+            # incident plane: fleetwide TTFT/queue detectors tick on
+            # the same signals the autoscaler reads
+            ttft_ms = sig.get("ttft_p99_ms")
+            self._agg.note_serve_signals(
+                queue_depth=sig["queued"],
+                ttft_p99_s=(ttft_ms / 1e3
+                            if ttft_ms is not None else None))
         if self._draining:
             return
         decision = self.autoscaler.tick(sig)
         if decision is None:
             return
+        if self._agg is not None:
+            # correlation event: an autoscale actuation right before a
+            # latency anomaly is a named cause (autoscale-thrash rule)
+            self._agg.note_event("autoscale",
+                                 action=decision["action"],
+                                 reason=decision.get("reason"))
         if decision["action"] == "grow":
             self._spawn_async(decision["reason"], autoscaled=True)
         else:

@@ -195,6 +195,13 @@ class Scheduler:
         self.failed = 0
         self._occupancy_sum = 0.0
         self._decode_steps = 0
+        # rolling latency tails (incident plane): the histograms above
+        # are cumulative-forever, so a live p99 regression drowns in
+        # history — these bounded deques carry only the recent window
+        # the serve detectors watch (server.py note_serve_signals)
+        from collections import deque
+        self._recent_ttfts: "deque[float]" = deque(maxlen=128)
+        self._recent_tpots: "deque[float]" = deque(maxlen=128)
 
     # -- admission ---------------------------------------------------------
 
@@ -380,6 +387,8 @@ class Scheduler:
             req.pos = len(req.tokens)       # the first token's position
             self._observe("rlt_serve_ttft_seconds", req.ttft_s,
                           status="ok")
+            if req.ttft_s is not None:
+                self._recent_ttfts.append(req.ttft_s)
             self._count("rlt_serve_tokens_total", 1, tenant=req.tenant)
             self._tenant(req.tenant).served_tokens += 1
             self._maybe_finish(req, tok)
@@ -418,6 +427,8 @@ class Scheduler:
             self.completed += 1
         req._finish()     # stamps t_done — tpot_s is defined only after
         self._observe("rlt_serve_tpot_seconds", req.tpot_s, status="ok")
+        if req.tpot_s is not None:
+            self._recent_tpots.append(req.tpot_s)
         self._count("rlt_serve_requests_total", 1, tenant=req.tenant,
                     status="ok")
         self._request_span(req, "ok")
@@ -514,6 +525,22 @@ class Scheduler:
                        "quota": t.quota}
                 for name, t in self._tenants.items()},
         }
+
+    @staticmethod
+    def _tail_p99(tail) -> Optional[float]:
+        vals = sorted(tail)
+        if not vals:
+            return None
+        return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+
+    def recent_ttft_p99(self) -> Optional[float]:
+        """p99 of the last ≤128 first-token latencies (None = no
+        completed prefills yet) — the serve detectors' TTFT signal."""
+        return self._tail_p99(self._recent_ttfts)
+
+    def recent_tpot_p99(self) -> Optional[float]:
+        """p99 of the last ≤128 per-token decode latencies."""
+        return self._tail_p99(self._recent_tpots)
 
     # -- metrics plumbing (no-ops when the metrics plane is off) -----------
 

@@ -275,7 +275,9 @@ class Server:
             cfg.resolve_dir(self.default_root_dir),
             heartbeat_timeout=cfg.heartbeat_timeout,
             hard_timeout=cfg.hard_timeout,
-            flight_capacity=cfg.flight_capacity)
+            flight_capacity=cfg.flight_capacity,
+            incident_cfg=cfg.resolved_incident(),
+            run_kind="serve")
         for i, w in enumerate(self._workers):
             agg.register_worker(i, w)
         telemetry.set_active(agg)
@@ -354,10 +356,18 @@ class Server:
         while not self._stop.is_set():
             self._drain_queue()
             self._watchdog()
-            if ledger is not None and time.monotonic() >= next_peek:
-                # live /status: ship a mid-run peek of the open ledger
-                # (the finalized doc replaces it at pump exit)
-                self._ship_goodput(ledger.peek())
+            if time.monotonic() >= next_peek:
+                if ledger is not None:
+                    # live /status: ship a mid-run peek of the open
+                    # ledger (the finalized doc replaces it at pump exit)
+                    self._ship_goodput(ledger.peek())
+                if self._agg is not None:
+                    # incident-plane serve detectors (queue depth,
+                    # TTFT/TPOT p99) tick at the same cadence
+                    self._agg.note_serve_signals(
+                        queue_depth=sched.queued_count,
+                        ttft_p99_s=sched.recent_ttft_p99(),
+                        tpot_p99_s=sched.recent_tpot_p99())
                 next_peek = time.monotonic() + 2.0
             plan = sched.plan()
             if plan is None:

@@ -75,6 +75,14 @@ from ray_lightning_tpu.telemetry.goodput import (  # noqa: F401
     measured_mfu,
     start_run,
 )
+from ray_lightning_tpu.telemetry.incident import (  # noqa: F401
+    Detector,
+    DetectorConfig,
+    Incident,
+    IncidentConfig,
+    IncidentManager,
+    TimelineStore,
+)
 from ray_lightning_tpu.telemetry.metrics import (  # noqa: F401
     MetricsRegistry,
     disable_metrics,
@@ -140,6 +148,12 @@ __all__ = [
     "get_anatomy_controller",
     "parse_trace_anatomy",
     "parse_anatomy_or_none",
+    "Detector",
+    "DetectorConfig",
+    "Incident",
+    "IncidentConfig",
+    "IncidentManager",
+    "TimelineStore",
 ]
 
 
@@ -182,6 +196,18 @@ class TelemetryConfig:
     anatomy_every_n_steps: Optional[int] = None
     #: dispatches traced per anatomy window
     anatomy_steps: int = 4
+    #: incident plane (telemetry/incident.py): driver-side timelines +
+    #: rolling anomaly detectors + auto-RCA incident reports.  On by
+    #: default whenever telemetry is enabled; RLT_INCIDENT=0 disarms
+    incident: bool = True
+    #: baseline samples per detector before it may trip
+    incident_warmup: int = 16
+    #: consecutive breached (healthy) samples to open (close)
+    incident_patience: int = 3
+    #: seconds after close before the same detector may re-trip
+    incident_cooldown_s: float = 30.0
+    #: per-(series, rank) timeline ring capacity
+    incident_capacity: int = 512
     #: goodput plane (telemetry/goodput.py): the per-run wall-clock
     #: partition + measured MFU.  None = armed whenever telemetry is
     #: enabled unless RLT_GOODPUT=0 disarms; an explicit bool wins
@@ -257,6 +283,19 @@ class TelemetryConfig:
             every = None
         return every, max(1, int(steps))
 
+    def resolved_incident(self) -> "IncidentConfig":
+        """Driver-side incident-plane config: these TelemetryConfig
+        fields as the base, with the ``RLT_INCIDENT*`` env merged on
+        top (env wins — the same precedence as every other knob)."""
+        from ray_lightning_tpu.telemetry.incident import IncidentConfig
+        base = IncidentConfig(
+            enabled=bool(self.incident),
+            capacity=int(self.incident_capacity),
+            warmup=int(self.incident_warmup),
+            patience=int(self.incident_patience),
+            cooldown_s=float(self.incident_cooldown_s))
+        return IncidentConfig.from_env(base=base)
+
     def resolved_goodput(self) -> bool:
         """Is the goodput ledger armed?  The explicit config bool wins;
         None defers to ``RLT_GOODPUT`` (unset = armed — goodput rides
@@ -298,6 +337,11 @@ class TelemetryConfig:
             out[_anatomy.ANATOMY_STEPS_ENV] = str(steps)
         if not self.resolved_goodput():
             out[_goodput.GOODPUT_ENV] = "0"
+        if not self.resolved_incident().enabled:
+            # detectors live on the driver, but workers gate their
+            # heartbeat sample tail + arm-file polling on the same knob
+            from ray_lightning_tpu.telemetry import incident as _incident
+            out[_incident.INCIDENT_ENV] = "0"
         tflops = self.resolved_goodput_tflops()
         if tflops is not None:
             out[_goodput.GOODPUT_TFLOPS_ENV] = str(tflops)

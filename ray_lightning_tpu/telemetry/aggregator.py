@@ -90,8 +90,10 @@ class TelemetryAggregator:
 
     def __init__(self, out_dir: str, heartbeat_timeout: float = 60.0,
                  hard_timeout: Optional[float] = None,
-                 clock=time.monotonic, flight_capacity: int = 256):
+                 clock=time.monotonic, flight_capacity: int = 256,
+                 incident_cfg=None, run_kind: str = "fit"):
         from ray_lightning_tpu.telemetry.flight import FlightRecorder
+        from ray_lightning_tpu.telemetry.incident import IncidentManager
         self.out_dir = out_dir
         self.heartbeat_timeout = heartbeat_timeout
         self.hard_timeout = hard_timeout
@@ -100,7 +102,25 @@ class TelemetryAggregator:
         #: ingested spans/heartbeats, dumpable independently of export
         self.flight = FlightRecorder(out_dir,
                                      span_capacity=flight_capacity)
+        #: incident plane (telemetry/incident.py): live timelines +
+        #: rolling anomaly detectors + auto-RCA reports, fed from every
+        #: ingest path below and ticked at sample arrival (driver-side
+        #: poll loops, never a worker hot path)
+        self.incidents = IncidentManager(
+            out_dir, cfg=incident_cfg, run_kind=run_kind, clock=clock,
+            flight_hook=lambda rank, cause: self.flight.dump(
+                rank, cause, handle=self._workers.get(rank)))
+        #: per-rank (start_ts, k) of the previous step span — the
+        #: step_interval_s series (start-to-start cadence) catches a
+        #: straggler whose sleep lands BETWEEN its own step spans
+        self._prev_step_span: dict[int, tuple] = {}
         self._lock = threading.Lock()
+        #: /status memoization: sections recompute only when the ingest
+        #: epoch moved (every mutation bumps it); scrapes between
+        #: ingests are dictionary lookups
+        self._epoch = 0
+        self._memo: dict[str, tuple] = {}
+        self.memo_recomputes: dict[str, int] = {}
         self._records: list[dict] = []
         #: pid -> {"at": driver clock, "beat": latest beat dict}; keyed
         #: by pid because the backend-level sender may beat before the
@@ -138,6 +158,24 @@ class TelemetryAggregator:
         self._goodput_latest: dict[int, dict] = {}
         self._replayed_steps = 0
 
+    # -- memoized section assembly ---------------------------------------
+
+    def _memoized(self, key: str, fn):
+        """Recompute ``fn`` only when the ingest epoch moved since its
+        last computation — /status scrapes of an idle aggregator cost
+        one dict lookup per section, not a full re-aggregation."""
+        with self._lock:
+            epoch = self._epoch
+            hit = self._memo.get(key)
+        if hit is not None and hit[0] == epoch:
+            return hit[1]
+        val = fn()
+        with self._lock:
+            self._memo[key] = (epoch, val)
+            self.memo_recomputes[key] = \
+                self.memo_recomputes.get(key, 0) + 1
+        return val
+
     # -- ingestion -------------------------------------------------------
 
     def register_worker(self, rank: int, handle: Any = None) -> None:
@@ -170,7 +208,9 @@ class TelemetryAggregator:
         doc = item.get("goodput") or {}
         with self._lock:
             self._goodput_latest[rank] = dict(doc)
+            self._epoch += 1
         self.flight.note_goodput(rank, doc)
+        self.incidents.note_goodput(doc)
 
     def set_replayed_steps(self, n: int) -> None:
         """Steps the resumed attempt re-executed after a snapshot-replay
@@ -178,11 +218,18 @@ class TelemetryAggregator:
         aggregate's ``step`` bucket into ``replay`` badput."""
         with self._lock:
             self._replayed_steps = max(0, int(n))
+            self._epoch += 1
+        if n:
+            self.incidents.note_event("replay", steps=int(n))
 
     def goodput_stats(self) -> dict:
         """Per-rank run-ledger docs + the fleet aggregate (identity
         ``sum(buckets) == run_wall`` holds on both levels) — the
-        ``goodput`` section of /status and the export summary."""
+        ``goodput`` section of /status and the export summary.
+        Memoized per ingest epoch."""
+        return self._memoized("goodput_stats", self._compute_goodput_stats)
+
+    def _compute_goodput_stats(self) -> dict:
         from ray_lightning_tpu.telemetry import goodput as _goodput
         with self._lock:
             latest = {r: dict(d)
@@ -211,12 +258,24 @@ class TelemetryAggregator:
         with self._lock:
             self._anatomy_latest[rank] = dict(anatomy)
             self._anatomy_windows += 1
+            self._epoch += 1
         self.flight.note_anatomy(rank, anatomy)
+        # incident evidence: a window arriving while an incident is
+        # open is exactly the capture that incident armed; the carried
+        # dir (incident-armed windows keep theirs) becomes the link
+        self.incidents.note_anatomy(rank, anatomy,
+                                    capture_dir=item.get("dir"))
+        self.incidents.note_event("anatomy", rank=rank,
+                                  dir=item.get("dir"))
 
     def anatomy_stats(self) -> dict:
         """Per-rank measured step anatomy + straggler skew (slowest
         rank's measured step wall / fastest's) — the ``anatomy``
-        section of /status and the export summary."""
+        section of /status and the export summary.  Memoized per
+        ingest epoch."""
+        return self._memoized("anatomy_stats", self._compute_anatomy_stats)
+
+    def _compute_anatomy_stats(self) -> dict:
         with self._lock:
             latest = {str(r): dict(a)
                       for r, a in sorted(self._anatomy_latest.items())}
@@ -241,6 +300,15 @@ class TelemetryAggregator:
             self._metrics_latest[rank] = item
             self._metrics_first_ts.setdefault(
                 rank, item.get("ts", time.time()))
+            self._epoch += 1
+        if self.incidents.cfg.enabled:
+            peaks = [float(m.get("value", 0.0))
+                     for m in item.get("metrics", ())
+                     if m.get("name") == "rlt_hbm_peak_bytes"]
+            if peaks:
+                self.incidents.note_sample(
+                    "hbm_peak_bytes", rank, max(peaks),
+                    ts=item.get("ts"))
 
     def latest_metrics(self) -> dict[int, dict]:
         """rank -> latest cumulative metrics window (exporter surface).
@@ -269,6 +337,7 @@ class TelemetryAggregator:
         aggregator rebuild (elastic/driver.py)."""
         with self._lock:
             self._restarts = int(n)
+            self._epoch += 1
 
     def set_recovery(self, mode: Optional[str],
                      seconds: Optional[float] = None) -> None:
@@ -280,10 +349,58 @@ class TelemetryAggregator:
         with self._lock:
             self._recovery_mode = mode
             self._recovery_seconds = seconds
+            self._epoch += 1
+        if mode is not None:
+            self.incidents.note_event("recovery", mode=mode,
+                                      seconds=seconds)
+
+    def note_event(self, name: str, **detail: Any) -> None:
+        """One correlated run event (compile, snapshot, snapshot_stall,
+        autoscale, plan, …) onto the incident timeline — the log a
+        fresh incident pulls as evidence."""
+        self.incidents.note_event(name, **detail)
+        with self._lock:
+            self._epoch += 1
+
+    def note_serve_signals(self, queue_depth: Optional[float] = None,
+                           ttft_p99_s: Optional[float] = None,
+                           tpot_p99_s: Optional[float] = None) -> None:
+        """Serve-plane driver signals (pump peek / fleet autoscaler
+        tick): the fleetwide TTFT/TPOT/queue-depth detector feed."""
+        if not self.incidents.cfg.enabled:
+            return
+        if queue_depth is not None:
+            self.incidents.note_sample("queue_depth", -1,
+                                       float(queue_depth))
+        if ttft_p99_s is not None:
+            self.incidents.note_sample("ttft_p99_s", -1,
+                                       float(ttft_p99_s))
+        if tpot_p99_s is not None:
+            self.incidents.note_sample("tpot_p99_s", -1,
+                                       float(tpot_p99_s))
+
+    def incident_stats(self) -> dict:
+        """The ``incidents`` section of /status and the export summary."""
+        return self.incidents.stats()
+
+    def timeline_window(self, series: Optional[str] = None,
+                        rank: Optional[int] = None,
+                        window_s: Optional[float] = None,
+                        downsample: int = 0) -> dict:
+        """The ``GET /timeline`` document (telemetry/exporter.py)."""
+        return self.incidents.timeline.window(
+            series=series, rank=rank, window_s=window_s,
+            downsample=downsample)
 
     def note_worker_alive(self, rank: int, alive: bool) -> None:
+        v = 1 if alive else 0
         with self._lock:
-            self._fleet_alive[rank] = 1 if alive else 0
+            # epoch-bump only on a real change: the watchdog re-probes
+            # liveness every poll iteration, and an unchanged verdict
+            # must not invalidate the memoized /status sections
+            if self._fleet_alive.get(rank) != v:
+                self._fleet_alive[rank] = v
+                self._epoch += 1
 
     def _update_fleet_health(self, now: float) -> None:
         """Refresh the per-rank liveness gauges: the backend's process
@@ -304,13 +421,19 @@ class TelemetryAggregator:
 
     def _driver_metrics(self) -> list[dict]:
         goodput = self.goodput_stats()
+        incident_samples = self.incidents.metric_samples()
+        # a lone all-zero incident gauge is not worth synthesizing a
+        # driver window for — only count the plane once it has news
+        if len(incident_samples) == 1 \
+                and not incident_samples[0]["value"]:
+            incident_samples = []
         with self._lock:
             fleet = dict(self._fleet_alive)
             restarts = self._restarts
             rec_mode = self._recovery_mode
             rec_s = self._recovery_seconds
         if not fleet and not restarts and rec_mode is None \
-                and not goodput:
+                and not goodput and not incident_samples:
             return []
         out = [{"name": "rlt_worker_alive", "type": "gauge",
                 "labels": {"worker": str(rank)}, "value": v}
@@ -340,6 +463,9 @@ class TelemetryAggregator:
                 out.append({"name": "rlt_mfu", "type": "gauge",
                             "labels": {"scope": "fleet"},
                             "value": fleet_gp["mfu"]})
+        # incident plane: rlt_incident_total{series,verdict} +
+        # rlt_incident_active ride the same driver-side rank -1 window
+        out.extend(incident_samples)
         return out
 
     def fleet_health(self) -> dict[int, int]:
@@ -352,7 +478,38 @@ class TelemetryAggregator:
             r.setdefault("rank", rank)
         with self._lock:
             self._records.extend(records)
+            self._epoch += 1
         self.flight.note_records(rank, records)
+        if self.incidents.cfg.enabled:
+            self._feed_timeline(records)
+
+    def _feed_timeline(self, records: list[dict]) -> None:
+        """Span-path timeline feed: per-step wall and data-wait samples
+        plus the step-cadence (start-to-start interval) series — the
+        interval catches a straggler whose stall lands BETWEEN its own
+        step spans (a sleep in a callback inflates no span, but the
+        whole fleet's cadence)."""
+        inc = self.incidents
+        for r in records:
+            if r.get("t") != "span":
+                continue
+            name = r.get("name")
+            rk = r.get("rank", -1)
+            ts = float(r.get("ts", 0.0))
+            dur = float(r.get("dur", 0.0))
+            if name == "step":
+                k = max(1, int((r.get("attrs") or {}).get("k", 1)))
+                inc.note_sample("step_wall_s", rk, dur / k, ts=ts + dur)
+                prev = self._prev_step_span.get(rk)
+                self._prev_step_span[rk] = (ts, k)
+                if prev is not None and ts > prev[0]:
+                    inc.note_sample("step_interval_s", rk,
+                                    (ts - prev[0]) / prev[1], ts=ts)
+            elif name == "data_wait":
+                inc.note_sample("data_wait_s", rk, dur, ts=ts + dur)
+            elif name == "compile":
+                inc.note_event("compile", ts=ts, rank=rk,
+                               seconds=round(dur, 6))
 
     def _note_heartbeat(self, beat: dict) -> None:
         key = beat.get("pid") or beat.get("rank", -1)
@@ -363,6 +520,13 @@ class TelemetryAggregator:
         self.flight.note_heartbeat(beat)
         self.flight.note_metrics_brief(beat.get("rank", -1),
                                        beat.get("metrics"))
+        # detector backstop: the beat's rolling sample tail keeps the
+        # timelines ticking when span batches are dropped under
+        # backpressure (entries the span path already fed are skipped
+        # by timestamp watermark inside note_tail)
+        if self.incidents.cfg.enabled and beat.get("samples"):
+            self.incidents.note_tail(beat.get("rank", -1),
+                                     beat.get("samples"))
 
     def heartbeats(self) -> dict:
         """Latest beat per worker process, with its current age on the
@@ -503,7 +667,11 @@ class TelemetryAggregator:
 
     def step_stats(self) -> dict:
         """Per-rank step-time percentiles + straggler skew.  Chunked
-        dispatch (k steps per span) is normalized to per-step time."""
+        dispatch (k steps per span) is normalized to per-step time.
+        Memoized per ingest epoch."""
+        return self._memoized("step_stats", self._compute_step_stats)
+
+    def _compute_step_stats(self) -> dict:
         per_rank: dict[int, list[float]] = {}
         with self._lock:
             records = list(self._records)
@@ -574,7 +742,12 @@ class TelemetryAggregator:
         ``request`` summary spans (+ worker ``prefill`` spans joined by
         trace id): TTFT split into queue wait vs prefill, decode time
         and TPOT — the "which phase is slow for WHICH tenant" surface
-        on ``/status`` and in the exported summary."""
+        on ``/status`` and in the exported summary.  Memoized per
+        ingest epoch."""
+        return self._memoized("tenant_breakdown",
+                              self._compute_tenant_breakdown)
+
+    def _compute_tenant_breakdown(self) -> dict[str, dict]:
         with self._lock:
             records = list(self._records)
         prefill_by_trace: dict[str, float] = {}
@@ -743,6 +916,9 @@ class TelemetryAggregator:
         os.makedirs(self.out_dir, exist_ok=True)
         trace_path = os.path.join(self.out_dir, "trace.json")
         jsonl_path = os.path.join(self.out_dir, "telemetry.jsonl")
+        # an incident whose series simply stopped (the run ended) closes
+        # with the reason on record before the summary freezes
+        self.incidents.close_all(reason="run_end")
         with self._lock:
             records = list(self._records)
             windows = list(self._metric_windows)
@@ -780,6 +956,11 @@ class TelemetryAggregator:
                 summary["goodput_fraction"] = fleet_gp["goodput_fraction"]
             if fleet_gp.get("mfu") is not None:
                 summary["mfu"] = fleet_gp["mfu"]
+        incidents = self.incident_stats()
+        if incidents.get("total"):
+            # incident plane (telemetry/incident.py): detected
+            # anomalies with their cause rankings + evidence links
+            summary["incidents"] = incidents
         collectives = self.collective_stats()
         hbm = self.hbm_stats()
         dropped = self.dropped_stats()

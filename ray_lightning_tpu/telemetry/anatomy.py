@@ -80,6 +80,11 @@ ANATOMY_ENV = "RLT_ANATOMY"
 ANATOMY_EVERY_ENV = "RLT_ANATOMY_EVERY_N_STEPS"
 ANATOMY_STEPS_ENV = "RLT_ANATOMY_STEPS"
 
+#: incident-plane arm channel (incident.py INCIDENT_CONTROL_ENV): when
+#: set in the worker env, every AnatomyController polls the arm file and
+#: forces an off-cadence evidence window on detector trip
+INCIDENT_CONTROL_ENV = "RLT_INCIDENT_CONTROL"
+
 #: default cadence when armed via bare RLT_ANATOMY=1 (dispatches between
 #: windows) and default window length (dispatches traced per window)
 DEFAULT_EVERY_N = 50
@@ -561,11 +566,17 @@ def write_synthetic_trace(trace_dir: str, ops: list[dict],
 
 # -- auto-capture: cadence-armed windows, parsed locally -------------------
 
-def anatomy_item(rank: int, anatomy: dict) -> dict:
+def anatomy_item(rank: int, anatomy: dict,
+                 capture_dir: Optional[str] = None) -> dict:
     """Wire item carrying one rank's compact anatomy dict (rides the
-    same worker→driver queue as span batches and metrics windows)."""
-    return {TELEMETRY_KEY: 1, "kind": "anatomy", "rank": rank,
+    same worker→driver queue as span batches and metrics windows).
+    ``capture_dir`` (incident-armed windows only) links the preserved
+    raw capture so the incident report can reference its evidence."""
+    item = {TELEMETRY_KEY: 1, "kind": "anatomy", "rank": rank,
             "ts": time.time(), "anatomy": anatomy}
+    if capture_dir:
+        item["dir"] = capture_dir
+    return item
 
 
 class AnatomyController:
@@ -591,6 +602,27 @@ class AnatomyController:
         self._window_id = 0
         self._dir: Optional[str] = None
         self._profiler = WorkerProfiler(rank=self.rank)
+        #: pending off-cadence arm ({"tag", "steps"}) — incident plane
+        self._forced: Optional[dict] = None
+        #: tag of the window currently capturing (None = cadence window)
+        self._active_tag: Optional[str] = None
+        # driver→worker arm channel: incident manager writes the arm
+        # file (incident.py write_arm_file), every rank polls it here —
+        # same shared-filesystem idiom as RLT_PROFILE_CONTROL
+        self._arm_watcher = None
+        ctl_path = os.environ.get(INCIDENT_CONTROL_ENV)
+        if ctl_path:
+            from ray_lightning_tpu.telemetry.incident import ArmWatcher
+            self._arm_watcher = ArmWatcher(ctl_path)
+
+    def arm_now(self, tag: Optional[str] = None,
+                steps: Optional[int] = None) -> None:
+        """Force the NEXT tick to open a window regardless of cadence —
+        the incident plane's "capture evidence after detection" hook.
+        The window's capture dir is preserved and linked on the wire
+        item instead of deleted."""
+        self._forced = {"tag": tag or "incident",
+                        "steps": int(steps) if steps else None}
 
     def tick(self) -> None:
         """Once per dispatch (loop-engine hook, next to profile_tick)."""
@@ -600,22 +632,34 @@ class AnatomyController:
             if not prof._active:       # window just closed: parse + ship
                 self._finish()
             return
+        if self._arm_watcher is not None and self._forced is None:
+            ctl = self._arm_watcher.poll()
+            if ctl is not None:
+                self.arm_now(tag=f"incident-{ctl.get('id')}",
+                             steps=ctl.get("steps"))
         self._dispatches += 1
-        if self._dispatches % self.every_n:
+        forced, self._forced = self._forced, None
+        if forced is None and self._dispatches % self.every_n:
             return
         self._window_id += 1
         d = tempfile.mkdtemp(prefix="rlt_anatomy_")
         self._dir = d
+        steps = (forced or {}).get("steps") or self.window
         prof.maybe_start({"id": f"anatomy-{self.rank}-{self._window_id}",
-                          "steps": self.window, "dir": d})
+                          "steps": steps, "dir": d})
         if not prof._active:
             # another window owns the profiler (e.g. an on-demand
-            # POST /debug/profile capture) — skip to the next cadence
+            # POST /debug/profile capture) — skip to the next cadence;
+            # a forced (incident) arm retries on the next dispatch
             shutil.rmtree(d, ignore_errors=True)
             self._dir = None
+            self._forced = forced
+        else:
+            self._active_tag = (forced or {}).get("tag")
 
     def _finish(self) -> None:
         d, self._dir = self._dir, None
+        tag, self._active_tag = self._active_tag, None
         try:
             anatomy = parse_anatomy_or_none(
                 os.path.join(d, f"rank{self.rank}"))
@@ -625,11 +669,16 @@ class AnatomyController:
             self.windows += 1
             self._publish_metrics(anatomy)
             if self.sink is not None:
-                self.sink(anatomy_item(self.rank, anatomy))
+                # incident-armed windows keep + link their raw capture
+                # (the evidence dir the report references); cadence
+                # windows ship the compact dict only and delete it
+                self.sink(anatomy_item(
+                    self.rank, anatomy,
+                    capture_dir=d if tag else None))
         except Exception:   # anatomy must never break the train loop
             _log.debug("anatomy window dropped", exc_info=True)
         finally:
-            if d:
+            if d and not tag:
                 shutil.rmtree(d, ignore_errors=True)
 
     def _publish_metrics(self, anatomy: dict) -> None:
@@ -699,6 +748,7 @@ __all__ = [
     "ANATOMY_ENV",
     "ANATOMY_EVERY_ENV",
     "ANATOMY_STEPS_ENV",
+    "INCIDENT_CONTROL_ENV",
     "DEFAULT_EVERY_N",
     "DEFAULT_WINDOW",
     "StepAnatomy",

@@ -16,6 +16,11 @@ scrapable while the run is live:
   additionally carries per-tenant TTFT/TPOT breakdowns (queue vs
   prefill vs decode attribution), the flight-recorder dump paths, and
   the profile-window state.
+- ``GET /timeline`` — the incident plane's ring buffers
+  (telemetry/incident.py): time-stamped samples for the load-bearing
+  series (step wall, data wait, exposed comm, TTFT/TPOT p99, queue
+  depth, goodput fraction, HBM peak) per rank plus correlated events,
+  with ``series``/``rank``/``window``/``downsample`` query params.
 - ``POST /debug/profile?steps=N`` — on-demand ``jax.profiler`` capture
   (telemetry/tracing.py controllers): the serve plane arms a window on
   the next plan broadcast; the fit plane writes the control file its
@@ -130,6 +135,11 @@ def render_status(aggregator, profile_controller=None) -> dict:
     if aggregator.flight.dumped:
         doc["flight_dumps"] = {str(r): p for r, p
                                in aggregator.flight.dumped.items()}
+    incidents = aggregator.incident_stats()
+    if incidents.get("enabled"):
+        # incident plane (telemetry/incident.py): open/recent incidents
+        # with cause ranking, plus detector + timeline state
+        doc["incidents"] = incidents
     if profile_controller is not None:
         doc["profile"] = profile_controller.status()
     return doc
@@ -153,6 +163,29 @@ class MetricsHTTPServer:
                     if self.path.split("?")[0] == "/metrics":
                         body = render_prometheus(agg).encode()
                         ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    elif self.path.split("?")[0] == "/timeline":
+                        # incident plane's ring buffers: time-stamped
+                        # samples per (series, rank) + correlated
+                        # events, windowed/downsampled server-side so
+                        # dashboards never pull the full rings
+                        from urllib.parse import parse_qs
+                        q = parse_qs(self.path.partition("?")[2])
+
+                        def _one(key):
+                            v = q.get(key, [None])[0]
+                            return v if v not in (None, "") else None
+
+                        rank_s = _one("rank")
+                        window_s = _one("window")
+                        doc = agg.timeline_window(
+                            series=_one("series"),
+                            rank=int(rank_s) if rank_s is not None
+                            else None,
+                            window_s=float(window_s)
+                            if window_s is not None else None,
+                            downsample=int(_one("downsample") or 0))
+                        body = json.dumps(doc).encode()
+                        ctype = "application/json"
                     elif self.path.split("?")[0] == "/status":
                         doc = render_status(agg, profiler)
                         if status_extra is not None:

@@ -54,10 +54,18 @@ def make_heartbeat(rank: int, actor_id: Optional[str] = None) -> dict:
     # latest metrics brief (step, HBM, last collective) so a wedged
     # rank's watchdog diagnosis says WHAT it was doing when it went
     # silent, not just that it did (telemetry/metrics.py)
-    from ray_lightning_tpu.telemetry.metrics import metrics_brief
+    from ray_lightning_tpu.telemetry.metrics import (metrics_brief,
+                                                     sample_tail)
     brief = metrics_brief()
     if brief is not None:
         beat["metrics"] = brief
+    # rolling sample tail (step wall / cadence / data wait): the
+    # incident detectors dedupe by timestamp watermark, so carrying the
+    # tail on every beat keeps them ticking even when span batches are
+    # dropped under backpressure (incident-plane satellite)
+    tail = sample_tail()
+    if tail:
+        beat["samples"] = tail
     return beat
 
 

@@ -56,7 +56,8 @@ UNIT_SUFFIXES = ("_bytes", "_seconds", "_total")
 #: from the unit-suffix rule — a 0/1 liveness verdict has no unit to
 #: carry.  Keep this list short and deliberate.
 UNITLESS_GAUGES = ("rlt_worker_alive", "rlt_recovery_mode",
-                   "rlt_goodput_fraction", "rlt_mfu")
+                   "rlt_goodput_fraction", "rlt_mfu",
+                   "rlt_incident_active")
 
 #: step-time histogram bounds (seconds): sub-ms dispatch latency up to
 #: multi-second giant-model steps
@@ -145,6 +146,10 @@ CORE_METRICS = (
     "rlt_plan_rejected_total",
     "rlt_plan_compiled_total",
     "rlt_plan_seconds",
+    # incident plane (telemetry/incident.py): detector trips by series
+    # and ranked verdict, plus how many incidents are open right now
+    "rlt_incident_total",
+    "rlt_incident_active",
 )
 
 
@@ -418,6 +423,38 @@ def metrics_item(rank: int, snapshot: list[dict]) -> dict:
 _registry: Optional[MetricsRegistry] = None
 _pump: Optional[_MetricsPump] = None
 
+# -- rolling sample tail (incident-plane satellite) ----------------------
+# A tiny fixed-size deque of the rank's most recent raw samples, attached
+# to every heartbeat (heartbeat.py make_heartbeat).  The driver's
+# incident detectors dedupe by timestamp watermark, so the tail keeps
+# them ticking when span batches are dropped under backpressure (the
+# blind spot behind the PR 9 `dropped` counter) — heartbeats are tiny
+# and never ride the span ring.
+from collections import deque as _deque
+
+SAMPLE_TAIL_LEN = 32
+_sample_tail: "_deque[dict]" = _deque(maxlen=SAMPLE_TAIL_LEN)
+_last_step_t: Optional[float] = None
+
+
+def note_tail_sample(series: str, value: float,
+                     ts: Optional[float] = None) -> None:
+    """Append one raw sample to the heartbeat tail (deque append is
+    atomic; no lock on the hot path)."""
+    _sample_tail.append({"s": series, "ts": ts if ts is not None
+                         else time.time(), "v": float(value)})
+
+
+def sample_tail() -> list[dict]:
+    """Snapshot of the rolling tail, oldest first (heartbeat payload)."""
+    return list(_sample_tail)
+
+
+def reset_sample_tail() -> None:
+    global _last_step_t
+    _sample_tail.clear()
+    _last_step_t = None
+
 
 def enable_metrics(rank: int = 0,
                    sink: Optional[Callable[[dict], None]] = None,
@@ -427,6 +464,7 @@ def enable_metrics(rank: int = 0,
     sink will consume the flushes)."""
     global _registry, _pump
     disable_metrics()
+    reset_sample_tail()
     _registry = MetricsRegistry(rank=rank, sink=sink)
     if pump and sink is not None:
         _pump = _MetricsPump(_registry, interval=interval).start()
@@ -517,12 +555,24 @@ def on_step(duration_s: float, k: int = 1,
     ``duration_s`` host seconds.  Observes the per-step-normalized time
     into the histogram, bumps the step counter, and charges every
     traced-collective cost ``k`` times."""
+    global _last_step_t
     reg = _registry
     if reg is None:
         return
     k = max(1, int(k))
     reg.histogram("rlt_step_time_seconds").observe(duration_s / k)
     reg.counter("rlt_steps_total").inc(k)
+    # heartbeat tail: per-step wall plus dispatch-to-dispatch cadence.
+    # The interval covers this dispatch AND the host time between
+    # dispatches (callbacks, snapshot stalls, a straggler's sleep) —
+    # inflation the in-span step wall cannot see, which is exactly what
+    # the driver's step_interval_s detector trips on.
+    now = time.time()
+    note_tail_sample("step_wall_s", duration_s / k, ts=now)
+    if _last_step_t is not None and now > _last_step_t:
+        note_tail_sample("step_interval_s", (now - _last_step_t) / k,
+                         ts=now)
+    _last_step_t = now
     if step is not None:
         reg.current_step = int(step)
     if reg.traced_bytes:
@@ -573,6 +623,7 @@ def on_data_wait(seconds: float) -> None:
     if reg is None:
         return
     reg.counter("rlt_data_wait_seconds_total").inc(seconds)
+    note_tail_sample("data_wait_s", seconds)
 
 
 def metrics_brief() -> Optional[dict]:

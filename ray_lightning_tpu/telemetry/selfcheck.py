@@ -335,6 +335,141 @@ def _check_goodput_config_roundtrip() -> None:
           "worker_env/RLT_GOODPUT* OK")
 
 
+def _check_incident_detector() -> None:
+    """Detector invariants: no false trip on stationary noise, a
+    MONOTONE breach predicate (a worse regression can never be judged
+    healthier), the patience/cooldown state machine."""
+    from ray_lightning_tpu.telemetry.incident import Detector, DetectorConfig
+
+    t = [0.0]
+    cfg = DetectorConfig(direction="high", warmup=8, patience=2,
+                         cooldown_s=5.0)
+    det = Detector("step_wall_s", 0, cfg, clock=lambda: t[0])
+    # stationary-but-noisy series: never trips
+    for i in range(30):
+        t[0] += 1.0
+        val = 0.1 + 0.002 * ((i * 7) % 5)
+        assert det.observe(val, ts=t[0]) is None, (i, val)
+    assert not det.tripped and det.trips == 0
+    band = det.band()
+    assert band is not None
+    med, lo, hi = band
+    assert lo <= med <= hi
+    # monotone breach predicate: once a value breaches, every larger
+    # value breaches too (probe an increasing ladder, flags must be
+    # sorted False..True)
+    probes = [hi * f for f in (0.25, 0.9, 0.999, 1.001, 1.5, 10.0, 1e6)]
+    flags = [det.breaches(v) for v in probes]
+    assert flags == sorted(flags), list(zip(probes, flags))
+    assert flags[-1] is True and flags[0] is False
+    # low-direction detector breaches on dips, not spikes
+    low = Detector("goodput_fraction", -1,
+                   DetectorConfig(direction="low", warmup=4, patience=1),
+                   clock=lambda: t[0])
+    for _ in range(6):
+        t[0] += 1.0
+        low.observe(0.9, ts=t[0])
+    assert low.breaches(0.1) and not low.breaches(2.0)
+    # patience: one breached sample is noise, the Nth is an incident
+    t[0] += 1.0
+    assert det.observe(50 * med, ts=t[0]) is None
+    t[0] += 1.0
+    ev = det.observe(50 * med, ts=t[0])
+    assert ev is not None and ev["transition"] == "opened", ev
+    assert det.tripped and det.trips == 1
+    # close needs `patience` consecutive healthy samples
+    t[0] += 1.0
+    assert det.observe(med, ts=t[0]) is None
+    t[0] += 1.0
+    ev = det.observe(med, ts=t[0])
+    assert ev is not None and ev["transition"] == "closed", ev
+    assert not det.tripped
+    # cooldown: breaches inside the window never accumulate a streak
+    for _ in range(4):
+        t[0] += 1.0   # still inside cooldown_s=5.0
+        assert det.observe(50 * med, ts=t[0]) is None
+    assert not det.tripped and det.trips == 1
+    # after cooldown the detector re-arms
+    t[0] += cfg.cooldown_s + 1.0
+    det.observe(50 * med, ts=t[0])
+    t[0] += 1.0
+    ev = det.observe(50 * med, ts=t[0])
+    assert ev is not None and ev["transition"] == "opened"
+    assert det.trips == 2
+    print("telemetry selfcheck: incident detector monotone + "
+          "patience/cooldown state machine OK")
+
+
+def _check_incident_schema() -> None:
+    """IncidentManager end-to-end in-process: a spike opens an incident,
+    the dump matches INCIDENT_SCHEMA_KEYS, recovery closes it, and the
+    divergence path carries its explicit verdict."""
+    import json
+    import os
+    import tempfile
+    from ray_lightning_tpu.telemetry.incident import (
+        INCIDENT_SCHEMA_KEYS,
+        IncidentConfig,
+        IncidentManager,
+    )
+
+    out = tempfile.mkdtemp(prefix="rlt_sc_incident_")
+    t = [0.0]
+    cfg = IncidentConfig(warmup=4, patience=2, cooldown_s=0.0)
+    mgr = IncidentManager(out, cfg=cfg, run_kind="fit",
+                          clock=lambda: t[0])
+    for i in range(12):
+        t[0] += 1.0
+        mgr.note_sample("step_wall_s", 1, 0.1 + 0.001 * (i % 3),
+                        ts=100.0 + t[0])
+    assert not mgr.open_incidents
+    for _ in range(2):
+        t[0] += 1.0
+        mgr.note_sample("step_wall_s", 1, 9.0, ts=100.0 + t[0])
+    st = mgr.stats()
+    assert st["enabled"] and st["total"] == 1, st
+    assert st["open"] and st["open"][0]["series"] == "step_wall_s"
+    assert st["open"][0]["rank"] == 1
+    path = st["open"][0]["path"]
+    assert path and os.path.exists(path), path
+    with open(path) as f:
+        doc = json.load(f)
+    assert set(doc) == set(INCIDENT_SCHEMA_KEYS), sorted(doc)
+    assert doc["state"] == "open" and doc["closed_ts"] is None
+    assert doc["trigger"]["value"] == 9.0
+    # recovery closes the incident and re-dumps with closed_ts set
+    for _ in range(2):
+        t[0] += 1.0
+        mgr.note_sample("step_wall_s", 1, 0.1, ts=100.0 + t[0])
+    assert not mgr.open_incidents
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["state"] == "closed" and doc["closed_ts"] is not None
+    # plan-divergence incidents carry their explicit verdict
+    inc = mgr.note_divergence({"ratio": 2.0, "modeled_comm_s": 1.0,
+                               "exposed_comm_s": 2.0})
+    assert inc is not None and inc.verdict == "replan-recommended"
+    assert mgr.note_divergence({"ratio": 1.1}) is None  # inside band
+    names = {m["name"] for m in mgr.metric_samples()}
+    assert names == {"rlt_incident_total", "rlt_incident_active"}, names
+    print("telemetry selfcheck: incident open/close round-trip, dump "
+          "schema matches INCIDENT_SCHEMA_KEYS")
+
+
+def _check_incident_metric_names() -> None:
+    from ray_lightning_tpu.telemetry.metrics import (
+        CORE_METRICS,
+        UNITLESS_GAUGES,
+        validate_metric_name,
+    )
+    names = ("rlt_incident_total", "rlt_incident_active")
+    assert set(names) <= set(CORE_METRICS), "incident metrics not core"
+    assert "rlt_incident_active" in UNITLESS_GAUGES
+    for name in names:
+        validate_metric_name(name)
+    print("telemetry selfcheck: incident metric names Prometheus-clean")
+
+
 def _main(argv: list) -> int:
     _check_span_schema()
     _check_trace_roundtrip()
@@ -346,6 +481,9 @@ def _main(argv: list) -> int:
     _check_goodput_partition()
     _check_goodput_metric_names()
     _check_goodput_config_roundtrip()
+    _check_incident_detector()
+    _check_incident_schema()
+    _check_incident_metric_names()
     return 0
 
 
