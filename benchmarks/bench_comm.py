@@ -25,6 +25,12 @@ line in the shared harness format with two extra fields:
   construction (no overlap is possible), which is exactly PR 10's
   caveat made visible in the JSON.
 
+The ZeRO-1 gather pair (``zero1_gather_bucketed`` vs
+``zero1_gather_barrier``, plus the ``zero1_int8`` floor they subtract)
+plays the same game on the OTHER collective: the updated-param
+all-gather, explicit + consumption-ordered + bucketed vs tied whole-tree
+monolithic, summarized in ``*_gather_overlap``.
+
 A meaningful A/B needs a real multi-device data mesh.  When the
 current process has one (a TPU slice / multi-host fleet), the legs run
 inline; on a single-device (or CPU) session the whole suite re-runs in
@@ -122,14 +128,14 @@ def _run_legs_inline(metric_prefix: str) -> list:
     batch = max(8, world)
     steps = WARMUP + TIMED + 4 + TRACE_STEPS
 
-    def leg(tag, policy, extra=None, trace_steps=0):
+    def leg(tag, policy, extra=None, trace_steps=0, strategy=None):
         module = GPTLightningModule("tiny", dataset_size=batch * steps,
                                     batch_size=batch)
         kwargs = {"comm_policy": policy} if policy is not None else {}
         res = run_steps_per_sec(
             module, f"{metric_prefix}_{tag}", warmup=WARMUP, timed=TIMED,
-            trainer_kwargs=kwargs, telemetry=False, extra_fields=extra,
-            trace_steps=trace_steps)
+            strategy=strategy, trainer_kwargs=kwargs, telemetry=False,
+            extra_fields=extra, trace_steps=trace_steps)
         if res.get("trace_dir"):
             shutil.rmtree(res.pop("trace_dir"), ignore_errors=True)
         return res
@@ -187,6 +193,76 @@ def _run_legs_inline(metric_prefix: str) -> list:
         }
         print(json.dumps(summary))
         results.append(summary)
+
+    # ZeRO-1 updated-param gather pair (ops/flash_decode PR's train
+    # leg): identical int8 reduction + explicit fp32 gather; the only
+    # difference is WHEN the gathers may issue — consumption-ordered
+    # buckets, each depending on its own leaves, vs one
+    # optimization_barrier tying the COMPLETE updated tree before any
+    # gather (the monolithic end-of-step construction).
+    from ray_lightning_tpu.comm import CommPolicy
+
+    z_floor = leg("zero1_int8", CommPolicy(compress="int8",
+                                           axes=("data",)),
+                  strategy="zero1")
+    z_floor_s = 1.0 / z_floor["value"]
+
+    def gather_differential(res):
+        step_s = 1.0 / res["value"]
+        out = {"step_seconds": round(step_s, 6),
+               "exposed_comm_seconds": round(step_s - z_floor_s, 6)}
+        m = (res.get("anatomy") or {}).get("exposed_s")
+        if m is not None:
+            out["measured_exposed_comm_seconds"] = round(m, 6)
+            out["exposed_divergence_seconds"] = round(
+                (step_s - z_floor_s) - m, 6)
+        return out
+
+    gather_pair = (
+        ("zero1_gather_bucketed",
+         CommPolicy(compress="int8", axes=("data",),
+                    gather_bucket_bytes=1 << 20)),
+        ("zero1_gather_barrier",
+         CommPolicy(compress="int8", axes=("data",),
+                    gather_bucket_bytes=1 << 20, barrier_sync=True)),
+    )
+    g_exposed, g_measured = {}, {}
+    results.append(z_floor)
+    for tag, policy in gather_pair:
+        res = leg(tag, policy, extra=gather_differential,
+                  trace_steps=TRACE_STEPS, strategy="zero1")
+        results.append(res)
+        g_exposed[tag] = res["exposed_comm_seconds"]
+        g_measured[tag] = res.get("measured_exposed_comm_seconds")
+    summary = {
+        "metric": f"{metric_prefix}_gather_overlap",
+        "barrier_exposed_s": round(
+            g_exposed["zero1_gather_barrier"], 6),
+        "bucketed_exposed_s": round(
+            g_exposed["zero1_gather_bucketed"], 6),
+        "barrier_measured_exposed_s":
+            g_measured["zero1_gather_barrier"],
+        "bucketed_measured_exposed_s":
+            g_measured["zero1_gather_bucketed"],
+        # judged on the TRACE-MEASURED exposure when a capture parsed
+        # (the wall-minus-floor proxy is sub-noise at gather scale on
+        # this model); wall proxy is the fallback
+        "overlap_wins": bool(
+            g_measured["zero1_gather_bucketed"]
+            < g_measured["zero1_gather_barrier"]
+            if None not in (g_measured["zero1_gather_bucketed"],
+                            g_measured["zero1_gather_barrier"])
+            else g_exposed["zero1_gather_bucketed"]
+            < g_exposed["zero1_gather_barrier"]),
+        "note": "exposed_s = wall minus same-process zero1+int8 floor "
+                "(no explicit gather); measured_* = trace-interval "
+                "overlap.  The same serial-executor caveat as the "
+                "reduction pair applies on the CPU proxy — the "
+                "scheduler freedom the buckets buy only pays on a "
+                "fabric that can overlap (ROADMAP item 5)",
+    }
+    print(json.dumps(summary))
+    results.append(summary)
     return results
 
 

@@ -42,6 +42,8 @@ import time
 
 import numpy as np
 
+from ray_lightning_tpu.ops.flash_decode import resolve_decode_impl
+
 #: serving geometry for the CPU-proxy run (tiny GPT, block 32)
 BUCKETS = (16, 32)
 SLOTS = 4
@@ -254,6 +256,9 @@ def run_fleet_ab(metric: str, requests: int = 64,
         "platform": platform,
         "slots": SLOTS,
         "page_size": PAGE_SIZE,
+        # env-resolved decode kernel (ops/flash_decode.py); paging is on
+        # and page-aligned here, so engines see the same resolution
+        "decode_kernel": resolve_decode_impl(None),
         "tokens_per_sec": headline["tokens_per_sec"],
         "ttft_p99_ms": headline["ttft_p99_ms"],
         "multipliers": {

@@ -133,6 +133,8 @@ def main() -> None:
         "ttft_p99_ms": round(float(np.percentile(ttfts, 99)), 2),
         "tpot_p50_ms": (round(float(np.percentile(tpots, 50)), 2)
                         if len(tpots) else None),
+        "decode_kernel": (workers[0].get("decode_kernel")
+                          if workers else None),
         "batch_occupancy": round(sched["batch_occupancy"], 3),
         "tenants": len(tenant_rows),
         "workers": num_workers,

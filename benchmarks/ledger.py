@@ -35,6 +35,9 @@ figure                          worse    band
 ``serve.ttft_p99_ms`` /
 ``fleet.ttft_p99_ms``           higher  ``serve_band`` +
                                         ``min_ttft_ms`` floor
+``serve.tpot_p50_ms`` /
+``fleet.tpot_p50_ms``           higher  ``serve_band`` +
+                                        ``min_tpot_ms`` floor
 ``goodput.fraction``            lower   ``goodput_band`` (default 10%)
                                         + ``min_goodput_delta``
                                         absolute floor
@@ -76,6 +79,9 @@ SERVE_BAND = 0.15
 MIN_EXPOSED_S = 1e-4
 #: absolute TTFT floor: p99 jitter below this is scheduler noise
 MIN_TTFT_MS = 2.0
+#: absolute TPOT floor: per-token p50 drift below half a millisecond is
+#: dispatch noise on the CPU proxy, not a decode-kernel regression
+MIN_TPOT_MS = 0.5
 #: goodput-fraction / MFU band (telemetry/goodput.py): whole-run wall
 #: attribution swings more than compiled-step time (compile/init share
 #: varies with cache state), so the band is wider than step_band
@@ -148,7 +154,8 @@ def compare(prev: Any, curr: Any, *, step_band: float = STEP_BAND,
             goodput_band: float = GOODPUT_BAND,
             incident_band: float = INCIDENT_BAND,
             min_exposed_s: float = MIN_EXPOSED_S,
-            min_ttft_ms: float = MIN_TTFT_MS) -> dict:
+            min_ttft_ms: float = MIN_TTFT_MS,
+            min_tpot_ms: float = MIN_TPOT_MS) -> dict:
     """Compare two rounds; the returned report's ``ok`` is the gate.
 
     ``prev``/``curr``: anything :func:`load_records` accepts.
@@ -211,6 +218,12 @@ def compare(prev: Any, curr: Any, *, step_band: float = STEP_BAND,
             check(metric, f"{key}.ttft_p99_ms", ps.get("ttft_p99_ms"),
                   cs.get("ttft_p99_ms"), "higher", serve_band,
                   floor=min_ttft_ms)
+            # per-output-token latency: the decode-kernel tier's
+            # headline — a slower hot path shows here before it moves
+            # tokens/s on a queue-bound replay
+            check(metric, f"{key}.tpot_p50_ms", ps.get("tpot_p50_ms"),
+                  cs.get("tpot_p50_ms"), "higher", serve_band,
+                  floor=min_tpot_ms)
         # goodput plane (telemetry/goodput.py `goodput` dict): the
         # useful-fraction of run wall and measured MFU are both
         # lower-is-worse; one-sided presence (a pre-goodput baseline)

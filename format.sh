@@ -48,6 +48,13 @@ if [[ "$CHECK" == 1 ]]; then
     # mesh (ray_lightning_tpu/comm/selfcheck.py)
     python -c 'import sys; from ray_lightning_tpu.comm.selfcheck \
         import _main; sys.exit(_main([]))'
+    # ops-plane selfcheck: decode-impl resolution precedence, the
+    # flash-decode grid-skip invariant (the index-map clamp and the
+    # kernel's compute guard must agree on every block), geometry
+    # gating, interpreter lowering parity vs the dense einsum, and the
+    # identity-page-table round-trip (ray_lightning_tpu/ops/selfcheck.py)
+    python -c 'import sys; from ray_lightning_tpu.ops.selfcheck \
+        import _main; sys.exit(_main([]))'
     # serve-plane selfcheck: bucket resolution + padding, scheduler
     # invariants (slot uniqueness, tenant quota, fair-share progress)
     # under a simulated multi-tenant run, serve metric names, and the

@@ -207,6 +207,12 @@ def collective_kind(name: str) -> "str | None":
     collective-name classification — the trace-anatomy parser and the
     HLO byte audit above must never disagree on what counts as comm."""
     n = name.lower()
+    # Pallas / custom-call kernels are compute, never comm — explicit
+    # guard so a kernel named after the data it touches (a fused
+    # "…all-gather…" epilogue, say) can't be misfiled as a collective
+    # and drain the anatomy's compute bucket.
+    if "pallas" in n or "custom-call" in n or "flash" in n:
+        return None
     for op in COLLECTIVE_OPS:
         if op in n:
             return op

@@ -341,6 +341,8 @@ class GradSync:
             tag += f"/hier{self.ici_size}x{self.dcn_size}"
         if self.policy.bucket_bytes > 0:
             tag += f"/bkt{self.policy.bucket_bytes >> 20}M"
+        if self.policy.gather_bucket_bytes > 0:
+            tag += f"/gbkt{self.policy.gather_bucket_bytes >> 20}M"
         return tag
 
     def _comm_kw(self) -> dict:
@@ -556,47 +558,93 @@ class GradSync:
         partitioner's post-update all-gather carries the compressed
         dtype.  ``with_sharding_constraint`` pins the update shard-wise
         (the ZeRO layout) and the replication constraint on the
-        compressed payload forms the low-precision all-gather."""
-        if self._param_gather_spec_fn is None \
-                or self.policy.param_gather == "none":
+        compressed payload forms the low-precision all-gather.
+
+        With ``policy.gather_bucket_bytes > 0`` the gather is
+        additionally LATENCY-HIDDEN: gatherable leaves are reordered
+        into the next forward's consumption order
+        (:func:`_consumption_order` — embeddings, then blocks by
+        numeric layer index; flax's alphabetical h0/h1/h10 is not
+        execution order), partitioned into size-targeted buckets
+        (:func:`partition_buckets`, the sync_bucketed machinery), and
+        each bucket's shard-side payloads are tied into one scheduling
+        unit with ``optimization_barrier`` — every bucket's all-gather
+        depends only on its own leaves' updates, so XLA's
+        latency-hiding scheduler can stream early buckets (the params
+        the next forward touches first) while later updates are still
+        computing, instead of draining one monolithic end-of-step
+        gather.  ``policy.barrier_sync`` (bench A/B) deliberately
+        rebuilds the monolith: ONE barrier over the whole tree before
+        any gather.  This path activates even with ``param_gather ==
+        "none"`` — the explicit gather then moves the param dtype
+        uncompressed; only the scheduling changes."""
+        gather_bkt = self.policy.gather_bucket_bytes
+        if self._param_gather_spec_fn is None or (
+                self.policy.param_gather == "none" and gather_bkt <= 0):
             return params
         mesh = self.mesh
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        sharded: list = [None] * len(flat)
+        gatherable: list[int] = []
+        for i, (path, p) in enumerate(flat):
+            spec = self._param_gather_spec_fn(mesh, _path_str(path), p)
+            if any(e is not None for e in spec):
+                gatherable.append(i)
+                sharded[i] = lax.with_sharding_constraint(
+                    p, NamedSharding(mesh, spec))
+
+        if gather_bkt > 0 and gatherable:
+            ordered = [gatherable[j] for j in _consumption_order(
+                [_path_str(flat[i][0]) for i in gatherable])]
+            if self.policy.barrier_sync:
+                groups = [ordered]       # monolithic A/B: one barrier
+            else:
+                sizes = [flat[i][1].size * flat[i][1].dtype.itemsize
+                         for i in ordered]
+                groups = [[ordered[j] for j in idxs]
+                          for idxs in partition_buckets(sizes, gather_bkt)]
+            for group in groups:
+                tied = lax.optimization_barrier(
+                    tuple(sharded[i] for i in group))
+                for i, t in zip(group, tied):
+                    sharded[i] = t
+
+        out = [p if sharded[i] is None
+               else self._gather_leaf(sharded[i], p)
+               for i, (_, p) in enumerate(flat)]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _gather_leaf(self, p_sh, p):
+        """Form one leaf's explicit all-gather: replicate-constrain the
+        (optionally codec-compressed) shard-constrained value."""
         mode = self.policy.param_gather
         bs = self.policy.block_size
-
-        def leaf(path, p):
-            pstr = _path_str(path)
-            spec = self._param_gather_spec_fn(mesh, pstr, p)
-            if not any(e is not None for e in spec):
-                return p      # too small to shard: no gather to compress
-            p_sh = lax.with_sharding_constraint(
-                p, NamedSharding(mesh, spec))
-            rep = NamedSharding(mesh, P())
-            if mode == "bf16":
-                q = lax.with_sharding_constraint(
-                    p_sh.astype(jnp.bfloat16), rep)
-                return q.astype(p.dtype)
-            # int8: blockwise along the last dim when it divides, else a
-            # per-tensor scale (padding a sharded dim inside global view
-            # could cost a reshard — not worth it for odd shapes)
-            if p.shape[-1] % bs == 0:
-                from ray_lightning_tpu.comm.quant import (
-                    blockwise_dequantize, blockwise_quantize)
-                q, scale = blockwise_quantize(
-                    p_sh.astype(jnp.float32), bs)
-                q = lax.with_sharding_constraint(q, rep)
-                scale = lax.with_sharding_constraint(scale, rep)
-                return blockwise_dequantize(q, scale, bs).astype(p.dtype)
-            amax = jnp.max(jnp.abs(p_sh.astype(jnp.float32)))
-            scale = amax / 127.0
-            inv = jnp.where(scale > 0, 1.0 / jnp.where(scale > 0, scale,
-                                                       1.0), 0.0)
-            q = jnp.clip(jnp.round(p_sh.astype(jnp.float32) * inv),
-                         -127, 127).astype(jnp.int8)
+        rep = NamedSharding(self.mesh, P())
+        if mode == "none":
+            return lax.with_sharding_constraint(p_sh, rep)
+        if mode == "bf16":
+            q = lax.with_sharding_constraint(
+                p_sh.astype(jnp.bfloat16), rep)
+            return q.astype(p.dtype)
+        # int8: blockwise along the last dim when it divides, else a
+        # per-tensor scale (padding a sharded dim inside global view
+        # could cost a reshard — not worth it for odd shapes)
+        if p.shape[-1] % bs == 0:
+            from ray_lightning_tpu.comm.quant import (
+                blockwise_dequantize, blockwise_quantize)
+            q, scale = blockwise_quantize(p_sh.astype(jnp.float32), bs)
             q = lax.with_sharding_constraint(q, rep)
-            return (q.astype(jnp.float32) * scale).astype(p.dtype)
-
-        return jax.tree_util.tree_map_with_path(leaf, params)
+            scale = lax.with_sharding_constraint(scale, rep)
+            return blockwise_dequantize(q, scale, bs).astype(p.dtype)
+        amax = jnp.max(jnp.abs(p_sh.astype(jnp.float32)))
+        scale = amax / 127.0
+        inv = jnp.where(scale > 0, 1.0 / jnp.where(scale > 0, scale,
+                                                   1.0), 0.0)
+        q = jnp.clip(jnp.round(p_sh.astype(jnp.float32) * inv),
+                     -127, 127).astype(jnp.int8)
+        q = lax.with_sharding_constraint(q, rep)
+        return (q.astype(jnp.float32) * scale).astype(p.dtype)
 
     # -- metrics accounting ----------------------------------------------
 
@@ -644,6 +692,33 @@ class GradSync:
         return total
 
 
+def _consumption_order(paths: "list[str]") -> "list[int]":
+    """Indices of ``paths`` sorted into the next forward's consumption
+    order: the embedding tables first (``wte``/``wpe`` feed the first
+    op of the next step), then transformer blocks by NUMERIC layer
+    suffix (``h0, h1, ..., h10`` — flax's alphabetical flatten order
+    puts h10 before h2), the final norm and any head last.  Ties break
+    on the path string so the order is deterministic.  This is the
+    order the latency-hidden ZeRO-1 gather buckets in: the earliest
+    bucket holds the params the forward touches first, so its gather
+    has the most downstream compute to hide behind."""
+    import re
+
+    def key(item):
+        _, pstr = item
+        head = pstr.split("/", 1)[0].lower()
+        if head in ("wte", "wpe", "embed", "embedding", "embeddings"):
+            return (0, 0, pstr)
+        m = re.fullmatch(r"[a-z_]*?(\d+)", head)
+        if m:
+            return (1, int(m.group(1)), pstr)
+        if head.startswith("ln_f") or head in ("final_norm", "norm_f"):
+            return (2, 0, pstr)
+        return (3, 0, pstr)
+
+    return [i for i, _ in sorted(enumerate(paths), key=key)]
+
+
 def _path_str(path) -> str:
     parts = []
     for p in path:
@@ -680,7 +755,7 @@ def build_grad_sync(strategy, mesh, policy) -> Optional[GradSync]:
     if not axes:
         return None
     spec_fn = None
-    if policy.param_gather != "none":
+    if policy.param_gather != "none" or policy.gather_bucket_bytes > 0:
         spec_fn = getattr(strategy, "param_gather_spec", None)
     return GradSync(mesh, axes, policy, strategy.data_axis_names,
                     param_gather_spec_fn=spec_fn)

@@ -16,8 +16,8 @@ CompileCacheConfig):
 - ``RLT_COMM=int8`` (+ ``RLT_COMM_AXES=data``, ``RLT_COMM_BLOCK=64``,
   ``RLT_COMM_SR=1``, ``RLT_COMM_EF=0``, ``RLT_COMM_PARAM_GATHER=bf16``,
   ``RLT_COMM_HIER=auto|K``, ``RLT_COMM_BUCKET_BYTES=N``,
-  ``RLT_COMM_BARRIER=1``) — env knobs, read when the Trainer arg is
-  ``None``.
+  ``RLT_COMM_BARRIER=1``, ``RLT_ZERO1_GATHER_BUCKET_BYTES=N``) — env
+  knobs, read when the Trainer arg is ``None``.
 
 The resolved policy is a frozen dataclass that pickles with the trainer
 driver→worker; the env knobs additionally round-trip through
@@ -91,7 +91,23 @@ class CommPolicy:
         COMPLETE gradient tree with an ``optimization_barrier`` before
         any collective is issued — the single end-of-backward barrier
         the bucketed path exists to beat.  Only meaningful with
-        ``bucket_bytes > 0``; never enable outside measurements.
+        ``bucket_bytes > 0``; never enable outside measurements.  Also
+        gates the gather side: with ``gather_bucket_bytes > 0`` it ties
+        the ENTIRE updated-param tree before any gather (the monolithic
+        end-of-step gather the bucketed path A/Bs against).
+    gather_bucket_bytes: ``0`` = ZeRO-1's updated-param all-gather stays
+        whatever ``param_gather`` makes it (implicit partitioner gather
+        when that is ``"none"`` too); ``> 0`` = the gather becomes
+        explicit and BUCKETED (``RLT_ZERO1_GATHER_BUCKET_BYTES``):
+        leaves are ordered by the next forward's consumption order
+        (embeddings, then blocks by numeric layer index), coalesced into
+        size-targeted buckets, and each bucket's gathers depend only on
+        its own leaves — the dataflow freedom XLA's latency-hiding
+        scheduler needs to overlap early buckets' gather traffic with
+        the remaining optimizer update and the next forward's first
+        matmuls (the cross-replica weight-update overlap of 2004.13336,
+        on the gather instead of the reduction).  Works with or without
+        a ``param_gather`` codec.
     """
 
     compress: str = "none"
@@ -103,6 +119,7 @@ class CommPolicy:
     hierarchy: int = 0
     bucket_bytes: int = 0
     barrier_sync: bool = False
+    gather_bucket_bytes: int = 0
 
     def __post_init__(self):
         if self.compress not in VALID_COMPRESS:
@@ -125,6 +142,9 @@ class CommPolicy:
                 f"group size >= 2")
         if self.bucket_bytes < 0:
             raise ValueError("comm_policy bucket_bytes must be >= 0")
+        if self.gather_bucket_bytes < 0:
+            raise ValueError(
+                "comm_policy gather_bucket_bytes must be >= 0")
         if self.axes is not None:
             object.__setattr__(self, "axes", tuple(self.axes))
 
@@ -156,6 +176,8 @@ class CommPolicy:
             hierarchy=hierarchy,
             bucket_bytes=int(os.environ.get("RLT_COMM_BUCKET_BYTES", "0")),
             barrier_sync=_env_flag("RLT_COMM_BARRIER", False),
+            gather_bucket_bytes=int(os.environ.get(
+                "RLT_ZERO1_GATHER_BUCKET_BYTES", "0")),
         )
 
     # -- queries ---------------------------------------------------------
@@ -215,6 +237,8 @@ class CommPolicy:
                               else str(self.hierarchy)),
             "RLT_COMM_BUCKET_BYTES": str(self.bucket_bytes),
             "RLT_COMM_BARRIER": "1" if self.barrier_sync else "0",
+            "RLT_ZERO1_GATHER_BUCKET_BYTES":
+                str(self.gather_bucket_bytes),
         }
         if self.axes is not None:
             env["RLT_COMM_AXES"] = ",".join(self.axes)

@@ -72,7 +72,8 @@ def _main(argv) -> int:   # noqa: ARG001 - argv kept for parity
     src = CommPolicy(compress="fp8", axes=("data",), block_size=128,
                      stochastic_rounding=True, error_feedback=False,
                      param_gather="bf16", hierarchy=2,
-                     bucket_bytes=1 << 20, barrier_sync=True)
+                     bucket_bytes=1 << 20, barrier_sync=True,
+                     gather_bucket_bytes=1 << 14)
     saved = {k: os.environ.get(k) for k in src.worker_env()}
     os.environ.update(src.worker_env())
     try:

@@ -269,7 +269,7 @@ def _stacked_kv(kv_tree):
     return ks, vs
 
 
-def build_decode_step(module) -> Callable:
+def build_decode_step(module, page_table=None) -> Callable:
     """Serve-plane continuous-batching decode program (sibling of
     :func:`build_train_step`; THE serving hot path).
 
@@ -279,14 +279,22 @@ def build_decode_step(module) -> Callable:
     caches ``[n_layer, S, L, H, D]``.  Static shapes by construction:
     request insertion/eviction is a slot-index change in the host-side
     scheduler, so decode never re-traces (serve/scheduler.py).
+
+    ``page_table`` ([S, pages_per_slot] int32 host array,
+    serve/fleet/pages.py ``identity_page_table``) selects the paged
+    flash-decode kernel's indirect KV fetch.  It is closed over as a
+    trace constant — the table geometry is fixed per engine, so the
+    program signature (and the zero-retrace contract) is unchanged.
     """
     module.setup_model()
     model = module.configure_decode_model()
+    kw = {} if page_table is None else {
+        "page_table": jnp.asarray(page_table, jnp.int32)}
 
     def step_fn(params, k_caches, v_caches, tokens, positions):
         logits, new_k, new_v = model.apply(
             {"params": params}, tokens, positions, k_caches, v_caches,
-            method="decode")
+            method="decode", **kw)
         return new_k, new_v, jnp.argmax(logits, axis=-1).astype(
             tokens.dtype)
 
@@ -323,7 +331,7 @@ def build_kv_copy() -> Callable:
     return copy_fn
 
 
-def build_suffix_step(module) -> Callable:
+def build_suffix_step(module, page_table=None) -> Callable:
     """Single-slot suffix-prefill program (the compute leg of prefix
     reuse, serve/fleet/pages.py).
 
@@ -337,16 +345,24 @@ def build_suffix_step(module) -> Callable:
     requested`` savings.  Unlike the batched decode program this writes
     NOTHING outside ``slot`` — no dummy writes to neighbors — so it can
     run mid-step without the serve plan's dispatch-order contract.
+
+    ``page_table`` here is the ONE-slot table (``identity_page_table(1,
+    L, page_size)``): the decode forward sees the cache sliced down to
+    its single slot, so physical pages are slice-relative — identical
+    for every slot, which is what lets one compiled program serve them
+    all.
     """
     module.setup_model()
     model = module.configure_decode_model()
+    kw = {} if page_table is None else {
+        "page_table": jnp.asarray(page_table, jnp.int32)}
 
     def step_fn(params, k_caches, v_caches, token, pos, slot):
         k1 = jax.lax.dynamic_slice_in_dim(k_caches, slot, 1, axis=1)
         v1 = jax.lax.dynamic_slice_in_dim(v_caches, slot, 1, axis=1)
         logits, nk, nv = model.apply(
             {"params": params}, token[None], pos[None], k1, v1,
-            method="decode")
+            method="decode", **kw)
         k_caches = jax.lax.dynamic_update_slice_in_dim(k_caches, nk,
                                                        slot, axis=1)
         v_caches = jax.lax.dynamic_update_slice_in_dim(v_caches, nv,

@@ -143,7 +143,7 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True, *,
-                 decode_cache=None, positions=None):
+                 decode_cache=None, positions=None, page_table=None):
         cfg = self.config
         attn = MultiHeadAttention(
             n_head=cfg.n_head, causal=True, dropout=cfg.dropout,
@@ -156,7 +156,8 @@ class Block(nn.Module):
             # cache alongside its output (ops/attention.py)
             a, new_cache = attn(h, deterministic,
                                 decode_cache=decode_cache,
-                                positions=positions)
+                                positions=positions,
+                                page_table=page_table)
             x = x + a
         else:
             x = x + attn(h, deterministic)
@@ -236,7 +237,8 @@ class GPT(nn.Module):
         # upcast to fp32 only for the loss softmax.
         return self.wte.attend(x).astype(jnp.float32)
 
-    def decode(self, tokens, positions, k_caches, v_caches):
+    def decode(self, tokens, positions, k_caches, v_caches,
+               page_table=None):
         """One continuous-batching decode step over ``S`` batch slots
         (the serve plane's hot program, ray_lightning_tpu/serve/).
 
@@ -250,7 +252,10 @@ class GPT(nn.Module):
 
         Use through ``configure_decode_model()`` (remat/dropout off);
         MoE configs are rejected by the serve engine (token routing is
-        batch-shaped, unsupported in the decode path).
+        batch-shaped, unsupported in the decode path).  ``page_table``
+        ([S, pages_per_slot] int32, serve/fleet/pages.py) rides down to
+        ``cached_attention`` for the paged flash-decode kernel; ``None``
+        keeps the slot-contiguous layout.
         """
         cfg = self.config
         x = self.wte(tokens[:, None])
@@ -260,7 +265,7 @@ class GPT(nn.Module):
         for i, blk in enumerate(self.blocks):
             x, (k, v) = blk(x, True,
                             decode_cache=(k_caches[i], v_caches[i]),
-                            positions=positions)
+                            positions=positions, page_table=page_table)
             new_k.append(k)
             new_v.append(v)
         x = self.ln_f(x)

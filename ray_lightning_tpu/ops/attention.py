@@ -114,7 +114,7 @@ def local_attention(q, k, v, **kw):
 
 
 def cached_attention(q, k_cache, v_cache, positions, *,
-                     dtype=jnp.bfloat16):
+                     dtype=jnp.bfloat16, impl=None, page_table=None):
     """Single-token attention against a slot-indexed KV cache (the serve
     plane's decode core, ray_lightning_tpu/serve/).
 
@@ -124,14 +124,44 @@ def cached_attention(q, k_cache, v_cache, positions, *,
     attends cache indices <= positions[s]: indices beyond its position
     hold stale prefill padding or a previous tenant's leftovers, which
     decode must never read (serve/kvcache.py invariant).
+
+    ``impl`` picks the kernel (explicit > ``RLT_DECODE_IMPL`` env >
+    ``auto``): ``dense`` is the masked einsum below; ``flash_decode`` is
+    the length-aware Pallas kernel (ops/flash_decode.py) that reads only
+    live KV blocks; ``paged`` additionally walks ``page_table``
+    ([S, pages_per_slot] int32, serve/fleet/pages.py) so the fetch is
+    page-indirect.  Unsupported geometry falls back to dense — same
+    numbers, no surprise crash on odd head shapes.
     """
+    from ray_lightning_tpu.ops.flash_decode import (
+        NEG_INF, decode_kernel_supported, flash_decode_attention,
+        resolve_decode_impl)
+
+    impl = resolve_decode_impl(impl)
+    if impl == "paged" and page_table is None:
+        impl = "flash_decode"  # no table plumbed: slot-contiguous kernel
+    if impl in ("flash_decode", "paged"):
+        S, _, H, D = q.shape
+        L = k_cache.shape[1]
+        bk = (L // page_table.shape[1] if impl == "paged"
+              else None)
+        from ray_lightning_tpu.ops.flash_decode import _pick_block_k
+        if decode_kernel_supported(L, H, D,
+                                   block_k=bk or _pick_block_k(L),
+                                   dtype=q.dtype):
+            return flash_decode_attention(
+                q, k_cache, v_cache, positions, dtype=dtype,
+                page_table=page_table if impl == "paged" else None)
     d = q.shape[-1]
     scores = jnp.einsum("sqhd,slhd->shql", q, k_cache,
                         preferred_element_type=jnp.float32)
     scores = scores / np.sqrt(d)
     valid = jnp.arange(k_cache.shape[1])[None, :] <= positions[:, None]
-    scores = jnp.where(valid[:, None, None, :], scores,
-                       jnp.finfo(jnp.float32).min)
+    # NEG_INF (-1e30), not finfo.min: the flash kernels' NaN-free
+    # masking constant — finfo.min survives one subtract in fp32 but a
+    # fully-masked row would softmax over exact -inf after scaling
+    # drift; -1e30 keeps exp/log finite everywhere
+    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
     return jnp.einsum("shql,slhd->sqhd", probs, v_cache)
 
@@ -149,6 +179,13 @@ def resolve_attention(impl: str) -> Callable:
     if impl == "ring":
         from ray_lightning_tpu.parallel.ring import ring_attention
         return ring_attention
+    if impl == "flash_decode":
+        # decode-path signature: (q, k_cache, v_cache, positions) — the
+        # serve plane's cached_attention kernel (ops/flash_decode.py),
+        # auto-selected on TPU the way auto_attention picks flash
+        from ray_lightning_tpu.ops.flash_decode import (
+            flash_decode_attention)
+        return flash_decode_attention
     raise ValueError(f"Unknown attention_impl {impl!r}")
 
 
@@ -168,7 +205,7 @@ class MultiHeadAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True, *,
-                 decode_cache=None, positions=None):
+                 decode_cache=None, positions=None, page_table=None):
         B, T, C = x.shape
         head_dim = C // self.n_head
         qkv = nn.Dense(3 * C, dtype=self.dtype, name="qkv")(x)
@@ -190,7 +227,7 @@ class MultiHeadAttention(nn.Module):
             k_cache = k_cache.at[slots, positions].set(k[:, 0])
             v_cache = v_cache.at[slots, positions].set(v[:, 0])
             y = cached_attention(q, k_cache, v_cache, positions,
-                                 dtype=self.dtype)
+                                 dtype=self.dtype, page_table=page_table)
             y = nn.Dense(C, dtype=self.dtype,
                          name="proj")(y.reshape(B, T, C))
             return y, (k_cache, v_cache)

@@ -1,0 +1,274 @@
+"""Flash-decode: the per-token serve hot path as a TPU Pallas kernel.
+
+``cached_attention`` (ops/attention.py) is a masked dense einsum: every
+decoded token reads ALL ``[S, L, H, D]`` cache rows and materializes
+``[S, H, 1, L]`` fp32 scores, however short each slot's live context is.
+Decode is bandwidth-bound — one query token against L cache rows — so
+the win is not FLOPs, it is *bytes not read*.  This kernel:
+
+- splits the KV cache into ``block_k``-row blocks on a ``(slot, kv
+  block)`` grid with an **online softmax** (running max ``m``, running
+  sum ``l``, rescaled accumulator ``acc`` in VMEM scratch, exactly the
+  flash forward decomposition of ops/flash_attention.py) and a final
+  combine at the last block;
+- is **length-aware**: ``positions`` rides the grid as a scalar-prefetch
+  operand (SMEM), so both the compute guard (``@pl.when``) AND the
+  BlockSpec index_map see each slot's bound.  The index_map *clamps*
+  dead blocks to the last live block — Pallas skips the DMA for a block
+  whose mapped index is unchanged from the previous grid step, so a slot
+  at position p reads ``ceil((p+1)/block_k)`` KV blocks, not ``L/block_k``;
+- has a **paged** variant whose KV index_map walks a page table
+  (``serve/fleet/pages.py identity_page_table``): the cache is viewed as
+  ``[S*pages_per_slot, page_size, C]`` physical pages and block ``p`` of
+  slot ``s`` fetches physical page ``table[s, p]``.  Today's table is the
+  identity (the device cache is slot-contiguous); the kernel contract is
+  already the indirect one, so physical page sharing only changes the
+  table.
+
+Heads are packed on the lane axis (``C = H*D``) and looped in-kernel
+with static column slices, mirroring the packed flash kernels.  On
+non-TPU backends everything runs under the Pallas interpreter so the
+tier-1 suite executes the real kernel on CPU.
+
+Numerics: fp32 softmax statistics, ``NEG_INF = -1e30`` masking (NaN-free
+under exp, ops/flash_attention.py idiom), output in the caller's compute
+dtype — parity with the dense einsum within the documented bf16 2e-2 bar
+(tests/test_ops.py decode-parity tier).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ray_lightning_tpu.ops.flash_attention import NEG_INF, _use_interpret
+
+VALID_DECODE_IMPLS = ("auto", "dense", "flash_decode", "paged")
+
+#: stable op-name tag: pallas custom-calls carry the kernel function
+#: name, and telemetry/anatomy.py buckets "flash"/"pallas"/"custom-call"
+#: names into compute (never collectives — comm/audit.py guard)
+_KERNEL_NAME = "flash_decode_kernel"
+
+
+def resolve_decode_impl(value=None) -> str:
+    """Decode attention impl: explicit value > ``RLT_DECODE_IMPL`` env >
+    ``auto`` (TPU → flash_decode, like ``auto_attention``; elsewhere the
+    dense einsum stays the default so CPU serving is untouched unless a
+    caller opts in)."""
+    v = (value or os.environ.get("RLT_DECODE_IMPL") or "auto").lower()
+    if v not in VALID_DECODE_IMPLS:
+        raise ValueError(
+            f"RLT_DECODE_IMPL must be one of {VALID_DECODE_IMPLS}, "
+            f"got {v!r}")
+    if v == "auto":
+        return ("flash_decode"
+                if jax.devices()[0].platform == "tpu" else "dense")
+    return v
+
+
+def kv_block_bound(kb: int, pos, block_k: int):
+    """The length-aware index_map clamp: the KV block index block ``kb``
+    actually fetches for a slot at position ``pos``.  Blocks past the
+    slot's bound re-map to the last live block (``pos // block_k``) —
+    an unchanged mapped index between sequential grid steps means Pallas
+    skips the block's DMA, which is the measured traffic saving.
+    Consistent with the compute guard: ``kb * block_k <= pos`` iff
+    ``kb <= pos // block_k`` (integer division)."""
+    return jnp.minimum(kb, pos // block_k)
+
+
+def decode_kernel_supported(L: int, H: int, D: int, *,
+                            block_k: int, dtype) -> bool:
+    """Whether the kernel path can lower for this cache geometry.  The
+    interpreter (non-TPU) takes anything; on TPU the packed lane axis
+    ``C = H*D`` must be a 128-lane multiple and blocks must tile L."""
+    C = H * D
+    if L % block_k:
+        return False
+    if _use_interpret():
+        return True
+    sub = 16 if dtype == jnp.bfloat16 else 8
+    return C % 128 == 0 and block_k % sub == 0
+
+
+def _pick_block_k(L: int) -> int:
+    b = min(int(os.environ.get("RLT_DECODE_BLOCK_K", "128") or 128), L)
+    while L % b:
+        b //= 2
+    return max(b, 1)
+
+
+def _decode_body(pos, kb, nk, logical_base,
+                 q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                 *, sm_scale, block_k, n_head, head_dim):
+    """Online-softmax update for one ``block_k``-row KV block of one
+    slot, looped over the packed heads.  ``logical_base`` is the block's
+    first LOGICAL cache row (page-table indirection moves only the
+    physical fetch; masking is always in logical positions)."""
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(kb * block_k <= pos)
+    def _compute():
+        rows = (jax.lax.broadcasted_iota(jnp.int32, (block_k, 1), 0)
+                + logical_base)
+        valid = rows <= pos
+        for h in range(n_head):
+            sl = slice(h * head_dim, (h + 1) * head_dim)
+            q = q_ref[0, :, sl]                       # [1, D]
+            k = k_ref[0, :, sl]                       # [block_k, D]
+            v = v_ref[0, :, sl]                       # [block_k, D]
+            s = jax.lax.dot_general(
+                k, q, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale  # [bk, 1]
+            s = jnp.where(valid, s, NEG_INF)
+            m_prev = m_ref[h, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s))
+            alpha = jnp.exp(m_prev - m_new)           # [1]
+            p = jnp.exp(s - m_new[0])                 # [bk, 1]
+            l_ref[h, :] = alpha[0] * l_ref[h, :]
+            l_ref[h, :1] = l_ref[h, :1] + jnp.sum(p)
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)   # [1, D]
+            acc_ref[h, :] = alpha[0] * acc_ref[h, :] + pv[0]
+            m_ref[h, :] = jnp.full_like(m_ref[h, :], m_new[0])
+
+    @pl.when(kb == nk - 1)
+    def _final():
+        for h in range(n_head):
+            sl = slice(h * head_dim, (h + 1) * head_dim)
+            # l > 0 always: logical row 0 satisfies ``0 <= pos`` for any
+            # non-negative position, so at least one key is live
+            o_ref[0, :, sl] = (acc_ref[h, :] / l_ref[h, 0])[None, :] \
+                .astype(o_ref.dtype)
+
+
+def flash_decode_kernel(positions_ref, q_ref, k_ref, v_ref, o_ref,
+                        m_ref, l_ref, acc_ref, **kw):
+    s, kb = pl.program_id(0), pl.program_id(1)
+    _decode_body(positions_ref[s], kb, pl.num_programs(1),
+                 kb * kw["block_k"], q_ref, k_ref, v_ref, o_ref,
+                 m_ref, l_ref, acc_ref, **kw)
+
+
+def flash_decode_paged_kernel(positions_ref, table_ref, q_ref, k_ref,
+                              v_ref, o_ref, m_ref, l_ref, acc_ref, **kw):
+    s, p = pl.program_id(0), pl.program_id(1)
+    _decode_body(positions_ref[s], p, pl.num_programs(1),
+                 p * kw["block_k"], q_ref, k_ref, v_ref, o_ref,
+                 m_ref, l_ref, acc_ref, **kw)
+
+
+def flash_decode_attention(q, k_cache, v_cache, positions, *,
+                           dtype=jnp.bfloat16, block_k=None,
+                           page_table=None, interpret=None):
+    """Length-aware flash decode over the slot cache.
+
+    ``q`` [S, 1, H, D]; ``k_cache``/``v_cache`` [S, L, H, D];
+    ``positions`` [S] int32; returns [S, 1, H, D] in ``dtype``.  With
+    ``page_table`` ([S, pages_per_slot] int32, physical page ids into
+    the ``[S*pages_per_slot, page_size, C]`` page view) the KV
+    index_map walks the table instead of the slot-contiguous layout;
+    ``page_size`` is implied by ``L // page_table.shape[1]``.
+    """
+    S, _, H, D = q.shape
+    L = k_cache.shape[1]
+    C = H * D
+    paged = page_table is not None
+    if paged:
+        n_pages = page_table.shape[1]
+        if L % n_pages:
+            raise ValueError(
+                f"page table with {n_pages} pages cannot tile L={L}")
+        bk = L // n_pages
+    else:
+        bk = block_k or _pick_block_k(L)
+    nk = L // bk
+    if interpret is None:
+        interpret = _use_interpret()
+
+    q2 = q.reshape(S, 1, C)
+    k2 = k_cache.reshape(S, L, C)
+    v2 = v_cache.reshape(S, L, C)
+
+    if paged:
+        # physical page view; the table maps (slot, logical page) ->
+        # physical page row
+        k2 = k2.reshape(S * nk, bk, C)
+        v2 = v2.reshape(S * nk, bk, C)
+
+        def kv_map(s, p, pos_ref, tab_ref):
+            return (tab_ref[s, kv_block_bound(p, pos_ref[s], bk)], 0, 0)
+
+        def sq_map(s, p, pos_ref, tab_ref):
+            return (s, 0, 0)
+
+        kernel = flash_decode_paged_kernel
+        scalars = (jnp.asarray(positions, jnp.int32),
+                   jnp.asarray(page_table, jnp.int32))
+        kv_block = (1, bk, C)
+    else:
+        def kv_map(s, kb, pos_ref):
+            return (s, kv_block_bound(kb, pos_ref[s], bk), 0)
+
+        def sq_map(s, kb, pos_ref):
+            return (s, 0, 0)
+
+        kernel = flash_decode_kernel
+        scalars = (jnp.asarray(positions, jnp.int32),)
+        kv_block = (1, bk, C)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=len(scalars),
+        grid=(S, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, C), sq_map),
+            pl.BlockSpec(kv_block, kv_map),
+            pl.BlockSpec(kv_block, kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, C), sq_map),
+        scratch_shapes=[
+            pltpu.VMEM((H, 128), jnp.float32),   # running max m
+            pltpu.VMEM((H, 128), jnp.float32),   # running sum l
+            pltpu.VMEM((H, D), jnp.float32),     # rescaled accumulator
+        ],
+    )
+    body = functools.partial(
+        kernel, sm_scale=1.0 / float(np.sqrt(D)), block_k=bk,
+        n_head=H, head_dim=D)
+    # both names keep the "flash" stem: the anatomy category table and
+    # the collective classifier key on it (telemetry/anatomy.py
+    # bucket_of, comm/audit.py collective_kind)
+    body.__name__ = _KERNEL_NAME if not paged \
+        else "flash_decode_paged_kernel"
+    out = pl.pallas_call(
+        body,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, 1, C), dtype),
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(*scalars, q2, k2, v2)
+    return out.reshape(S, 1, H, D)
+
+
+__all__ = [
+    "NEG_INF",
+    "VALID_DECODE_IMPLS",
+    "decode_kernel_supported",
+    "flash_decode_attention",
+    "kv_block_bound",
+    "resolve_decode_impl",
+]

@@ -326,23 +326,37 @@ class Zero1Strategy(ShardingStrategy):
         grad phases carry the compressed payload (+ their all-gather
         leg) and the param gather charges at its policy dtype; a
         hierarchical sync declares the grad phases per link tier
-        (``_dcn``/``_ici`` suffixes, see the base class)."""
+        (``_dcn``/``_ici`` suffixes, see the base class).
+
+        An honest declaration of the LATENCY-HIDDEN gather
+        (``policy.gather_bucket_bytes > 0``, comm/collectives.py
+        ``regather_params``): the bytes on the wire are unchanged —
+        bucketing moves WHEN the gather runs, not how much it moves —
+        so the payload is identical, but the op is keyed
+        ``param_all_gather_bucketed`` so the planner's cost model
+        (plan/cost.py ``op_overlap_factor``) can price the portion XLA
+        hides behind the next forward's compute, and the audit/drift
+        guards (tests/test_plan.py) can band it separately."""
         if self.data_parallel_size(mesh) <= 1:
             return {}
         if comm is not None:
+            gather_key = ("param_all_gather_bucketed"
+                          if comm.policy.gather_bucket_bytes > 0
+                          and not comm.policy.barrier_sync
+                          else "param_all_gather")
             n = self._tree_elements(abstract_state.params)
             if comm.hierarchical:
                 link = comm.psum_link_bytes(n)
                 return {
                     "grad_sync_dcn": link["dcn"],
                     "grad_sync_ici": link["ici"],
-                    "param_all_gather": comm.param_gather_wire_bytes(
+                    gather_key: comm.param_gather_wire_bytes(
                         abstract_state.params),
                 }
             return {
                 "grad_reduce_scatter": comm.reduce_scatter_wire_bytes(n),
                 "grad_all_gather": comm.all_gather_wire_bytes(n),
-                "param_all_gather": comm.param_gather_wire_bytes(
+                gather_key: comm.param_gather_wire_bytes(
                     abstract_state.params),
             }
         params = self._tree_bytes(abstract_state.params)

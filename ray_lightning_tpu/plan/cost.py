@@ -132,6 +132,24 @@ def link_gbps(op: str, config: PlanConfig, process_count: int) -> float:
     return config.dcn_gbps if process_count > 1 else config.ici_gbps
 
 
+#: modeled fraction of a ``_bucketed`` collective's time that stays
+#: EXPOSED after XLA's latency-hiding scheduler overlaps it with
+#: adjacent compute.  Deliberately conservative (half hidden): the
+#: planner must not promise overlap the fabric can't deliver; the
+#: measured judge is bench_comm's anatomy exposed-comm A/B, and the
+#: declared bytes stay the full payload (only seconds are discounted —
+#: bucketing moves WHEN bytes travel, never how many).
+BUCKETED_EXPOSED_FRACTION = 0.5
+
+
+def op_overlap_factor(op: str) -> float:
+    """Multiplier on one declared op's modeled seconds: ``_bucketed``
+    ops (the latency-hidden ZeRO-1 param gather,
+    comm/collectives.py ``regather_params``) count only their modeled
+    exposed fraction; every other op is fully exposed."""
+    return BUCKETED_EXPOSED_FRACTION if op.endswith("_bucketed") else 1.0
+
+
 def device_memory_budget(device, config: PlanConfig) -> Optional[int]:
     """Per-device HBM budget: the config override, the runtime's
     reported limit, or the known-HBM-by-kind table the donation
@@ -215,6 +233,7 @@ def estimate_candidate(
     comm_bytes = int(sum(op_bytes.values()))
     comm_seconds = sum(
         bytes_to_seconds(b, link_gbps(op, config, process_count))
+        * op_overlap_factor(op)
         for op, b in op_bytes.items())
 
     state_bytes = sharded_bytes(abstract_state, shardings)

@@ -67,6 +67,11 @@ class ServeEngine:
         #: programs that make prefix-cache hits executable
         self.paged = paged if paged is not None and paged.enabled \
             else None
+        #: which decode attention kernel the compiled program uses —
+        #: dense | flash_decode | paged (resolved at setup from
+        #: RLT_DECODE_IMPL, ops/flash_decode.py); benches emit it so a
+        #: kernel regression is visible in the JSON ledger
+        self.decode_kernel = "dense"
         self.trace_counts: dict[str, int] = {}
         self.kv_spec: Optional[KVCacheSpec] = None
         self.params = None
@@ -170,13 +175,35 @@ class ServeEngine:
         for b in self.buckets:
             self._prefills[b] = jit_step(
                 f"prefill_{b}", build_prefill_step(module, b), 3)
-        self._decode = jit_step("decode", build_decode_step(module), 2)
+
+        # decode kernel selection (ops/flash_decode.py): the paged
+        # kernel needs a page table whose pages tile the cache; when
+        # paging is off or ragged, "paged" degrades to the
+        # slot-contiguous flash kernel rather than failing setup
+        from ray_lightning_tpu.ops.flash_decode import resolve_decode_impl
+        impl = resolve_decode_impl(None)
+        page_table = suffix_table = None
+        if impl == "paged":
+            if self.paged is not None \
+                    and self.max_seq_len % self.paged.page_size == 0:
+                from ray_lightning_tpu.serve.fleet.pages import (
+                    identity_page_table)
+                page_table = identity_page_table(
+                    self.slots, self.max_seq_len, self.paged.page_size)
+                suffix_table = identity_page_table(
+                    1, self.max_seq_len, self.paged.page_size)
+            else:
+                impl = "flash_decode"
+        self.decode_kernel = impl
+        self._decode = jit_step(
+            "decode", build_decode_step(module, page_table=page_table), 2)
         if self.paged is not None:
             # paged-KV programs (serve/fleet/pages.py): a masked page
             # copy for prefix-cache hits + the single-slot suffix step
             # that computes only the unmatched tail of a prompt
-            self._suffix = jit_step("suffix", build_suffix_step(module),
-                                    3)
+            self._suffix = jit_step(
+                "suffix",
+                build_suffix_step(module, page_table=suffix_table), 3)
             ckw: dict = {"donate_argnums": (0, 1)}
             if multi:
                 ckw["in_shardings"] = (kv_sh, kv_sh, rep, rep, rep)
@@ -333,6 +360,7 @@ class ServeEngine:
         s = compile_cache.stats()
         warm = getattr(self, "trace_counts_at_warmup", {})
         return {
+            "decode_kernel": self.decode_kernel,
             "traces": dict(self.trace_counts),
             # traces since the warmup snapshot: 0 everywhere = the
             # decode loop never re-traced while serving

@@ -109,6 +109,30 @@ class PageConfig:
                 "RLT_SERVE_PAGE_SIZE": str(self.page_size)}
 
 
+def identity_page_table(slots: int, max_seq_len: int,
+                        page_size: int) -> np.ndarray:
+    """``[slots, pages_per_slot]`` int32 physical-page table for the
+    slot-contiguous device cache: page ``p`` of slot ``s`` lives at
+    physical page ``s * pages_per_slot + p`` of the
+    ``[slots * pages_per_slot, page_size, C]`` page view.
+
+    This is the table the paged flash-decode kernel
+    (ops/flash_decode.py) walks in its KV BlockSpec index_map.  Today
+    the mapping is the identity because the cache IS slot-contiguous
+    (module docstring: paging is host accounting, not physical
+    indirection) — but the kernel contract is already the indirect one,
+    so physical page sharing later only changes this table, not the
+    kernel.  Requires ``page_size`` to tile ``max_seq_len`` exactly
+    (a ragged final page would alias rows of the next slot)."""
+    if max_seq_len % page_size:
+        raise ValueError(
+            f"page_size {page_size} must tile max_seq_len "
+            f"{max_seq_len} for the paged decode kernel")
+    pages_per_slot = max_seq_len // page_size
+    return (np.arange(slots, dtype=np.int32)[:, None] * pages_per_slot
+            + np.arange(pages_per_slot, dtype=np.int32)[None, :])
+
+
 class PagePool:
     """Free-list over the fixed-size pages backing the slot cache.
 
@@ -366,4 +390,5 @@ class PagedKV:
         }
 
 
-__all__ = ["PageConfig", "PagePool", "PrefixIndex", "PagedKV"]
+__all__ = ["PageConfig", "PagePool", "PrefixIndex", "PagedKV",
+           "identity_page_table"]

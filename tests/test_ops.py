@@ -366,3 +366,84 @@ def test_fwd_rowres_with_grid_tri_backward(monkeypatch):
     for a, b, name in zip(g_flash, g_ref, "qkv"):
         np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5,
                                    err_msg=f"d{name} fwd-rowres+tri-bwd")
+
+
+# -- decode kernel tier (ops/flash_decode.py) ------------------------------
+
+
+def _rand_decode(s=4, L=256, h=2, d=32, seed=3, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (s, 1, h, d), dtype)
+    kc = jax.random.normal(ks[1], (s, L, h, d), dtype)
+    vc = jax.random.normal(ks[2], (s, L, h, d), dtype)
+    return q, kc, vc
+
+
+def _decode(impl, q, kc, vc, pos, dtype=jnp.float32, page_table=None):
+    from ray_lightning_tpu.ops.attention import cached_attention
+    return cached_attention(q, kc, vc, jnp.asarray(pos, jnp.int32),
+                            dtype=dtype, impl=impl,
+                            page_table=page_table)
+
+
+def test_flash_decode_matches_dense_ragged():
+    """Length-aware kernel vs the masked dense einsum across ragged
+    per-slot positions — including position 0 (single valid index) and
+    the last index of the cache."""
+    q, kc, vc = _rand_decode()
+    pos = [0, 17, 128, 255]
+    ref = _decode("dense", q, kc, vc, pos)
+    out = _decode("flash_decode", q, kc, vc, pos)
+    assert out.shape == ref.shape == q.shape
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_decode_single_slot():
+    q, kc, vc = _rand_decode(s=1, L=128)
+    ref = _decode("dense", q, kc, vc, [63])
+    out = _decode("flash_decode", q, kc, vc, [63])
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_decode_bf16_tolerance():
+    """bf16 caches (the serve plane's storage dtype) stay within bf16
+    rounding of the dense reference."""
+    q, kc, vc = _rand_decode(dtype=jnp.bfloat16)
+    pos = [5, 100, 200, 255]
+    ref = _decode("dense", q, kc, vc, pos, dtype=jnp.bfloat16)
+    out = _decode("flash_decode", q, kc, vc, pos, dtype=jnp.bfloat16)
+    np.testing.assert_allclose(out.astype(jnp.float32),
+                               ref.astype(jnp.float32),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_paged_decode_page_boundary_straddle():
+    """The paged variant (identity page table — slot-contiguous cache)
+    must agree with dense at positions ON and AROUND page boundaries,
+    where an off-by-one in the table walk or the logical-position
+    masking would surface, and agree bitwise with the slot-contiguous
+    kernel at matching block size."""
+    from ray_lightning_tpu.ops.flash_decode import flash_decode_attention
+    from ray_lightning_tpu.serve.fleet.pages import identity_page_table
+    page = 64
+    q, kc, vc = _rand_decode(s=4, L=256)
+    table = jnp.asarray(identity_page_table(4, 256, page))
+    pos = [page - 1, page, 2 * page + 1, 255]
+    ref = _decode("dense", q, kc, vc, pos)
+    out = _decode("paged", q, kc, vc, pos, page_table=table)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    flat = flash_decode_attention(
+        q, kc, vc, jnp.asarray(pos, jnp.int32), dtype=jnp.float32,
+        block_k=page)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(flat))
+
+
+def test_dense_decode_fully_masked_no_nan():
+    """satellite pin: the dense path masks with NEG_INF (-1e30), not
+    finfo.min — a fully-masked row (position -1: nothing valid yet)
+    softmaxes to finite uniform weights instead of NaN, and position 0
+    reduces to exactly v[:, 0]."""
+    q, kc, vc = _rand_decode(s=2, L=64)
+    out = _decode("dense", q, kc, vc, [-1, 0])
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(out[1, 0], vc[1, 0], atol=2e-5, rtol=2e-5)

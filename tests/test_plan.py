@@ -593,6 +593,8 @@ _DRIFT_LEGS = (
     ("ddp", "hier", CommPolicy(compress="int8", axes=("data",),
                                hierarchy=4)),
     ("ddp", "fp8", CommPolicy(compress="fp8", axes=("data",))),
+    ("zero1", "gather", CommPolicy(compress="int8", axes=("data",),
+                                   gather_bucket_bytes=1 << 14)),
 )
 
 
@@ -716,6 +718,36 @@ def test_drift_hierarchical_per_link_attribution(drift_programs):
     # flat int8 declaration's total (only the 1/ici shard crosses)
     flat_declared = sum(drift_programs[("ddp", True)]["declared"].values())
     assert 2 * declared_dcn <= flat_declared, (declared_dcn, flat_declared)
+
+
+def test_drift_bucketed_gather_declaration_tracks_audit(drift_programs):
+    """ZeRO-1 with the EXPLICIT bucketed updated-param gather
+    (gather_bucket_bytes > 0): the declaration renames the gather op
+    ``param_all_gather_bucketed`` at UNCHANGED bytes (the buckets move
+    the same payload — only the dependence structure differs), the
+    compiled program still tracks the same calibrated band as the plain
+    compressed leg, and the planner's cost model discounts ONLY the
+    bucketed op's seconds (BUCKETED_EXPOSED_FRACTION), never its
+    bytes."""
+    from ray_lightning_tpu.plan.cost import (
+        BUCKETED_EXPOSED_FRACTION, op_overlap_factor)
+
+    p = drift_programs[("zero1", "gather")]
+    plain = drift_programs[("zero1", True)]
+    assert "param_all_gather_bucketed" in p["declared"], p["declared"]
+    assert "param_all_gather" not in p["declared"], p["declared"]
+    assert p["declared"]["param_all_gather_bucketed"] == \
+        plain["declared"]["param_all_gather"], (p["declared"],
+                                                plain["declared"])
+    declared = sum(p["declared"].values())
+    audited = total_wire_bytes(p["text"], axis_size=8)
+    assert 0.7 <= audited / declared <= 2.0, (audited, declared)
+    # the cost model's declared-overlap discount: half the seconds on
+    # the bucketed op, full price everywhere else
+    assert op_overlap_factor(
+        "param_all_gather_bucketed") == BUCKETED_EXPOSED_FRACTION
+    assert op_overlap_factor("param_all_gather") == 1.0
+    assert op_overlap_factor("grad_reduce_scatter") == 1.0
 
 
 def test_drift_fp8_declaration_tracks_audit(drift_programs):

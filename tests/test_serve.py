@@ -368,6 +368,37 @@ def test_engine_zero_retraces_across_slots_lengths_buckets(engine):
         eng.trace_counts
 
 
+@pytest.mark.parametrize("impl", ["flash_decode", "paged"])
+def test_engine_kernel_decode_parity_and_zero_retrace(impl, monkeypatch):
+    """RLT_DECODE_IMPL forces the Pallas decode kernel (interpret mode
+    on CPU): greedy outputs match the dense engine token-for-token, the
+    page table rides as a closure constant (not a traced arg) so every
+    program still traces ONCE ever, and stats() reports which kernel
+    serves the hot path."""
+    from ray_lightning_tpu.serve.fleet.pages import PageConfig
+    monkeypatch.setenv("RLT_DECODE_IMPL", impl)
+    paged = PageConfig(enabled=True, page_size=8) if impl == "paged" \
+        else None
+    module = GPTLightningModule(TINY)
+    eng = ServeEngine(module, DataParallelStrategy(), buckets=(8,),
+                      slots=4, max_seq_len=TINY.block_size,
+                      seed=0, paged=paged).setup()
+    assert eng.stats()["decode_kernel"] == impl
+    prompt = np.array([5, 9, 2, 7, 11, 3, 1], np.int32)
+    got = _generate(eng, 1, prompt, 6)
+    monkeypatch.setenv("RLT_DECODE_IMPL", "dense")
+    dense = ServeEngine(GPTLightningModule(TINY), DataParallelStrategy(),
+                        buckets=(8,), slots=4,
+                        max_seq_len=TINY.block_size, seed=0,
+                        paged=paged).setup()
+    assert dense.stats()["decode_kernel"] == "dense"
+    assert got == _generate(dense, 1, prompt, 6), impl
+    # zero retraces: more decode traffic on other slots reuses programs
+    before = dict(eng.trace_counts)
+    _generate(eng, 3, np.array([9, 1], np.int32), 3)
+    assert eng.trace_counts == before, impl
+
+
 # -- 2-worker e2e: the acceptance run --------------------------------------
 
 def test_e2e_two_workers_multi_tenant_live_metrics(tmp_path, seed,

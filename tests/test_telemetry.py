@@ -445,6 +445,18 @@ def test_anatomy_parses_real_capture(tmp_path, monkeypatch):
     # the controller's status links the parsed anatomy per rank
     assert "anatomy" in status, status
     assert status["anatomy"]["0"]["compute_s"] > 0
+    # Pallas decode-kernel events land in compute, not comm: the
+    # category table files them under pallas/custom and the collective
+    # classifier (the one the anatomy parser consults) rejects them.
+    from ray_lightning_tpu.comm import audit
+    assert anatomy.bucket_of(
+        "flash_decode_kernel.12") == "pallas/custom"
+    assert anatomy.bucket_of(
+        "flash_decode_paged_kernel") == "pallas/custom"
+    assert audit.collective_kind("flash_decode_kernel.12") is None
+    assert audit.collective_kind(
+        "custom-call.flash_decode_paged_kernel") is None
+    assert audit.collective_kind("all-gather.7") == "all-gather"
 
 
 def test_anatomy_golden_overlap_math(tmp_path):
