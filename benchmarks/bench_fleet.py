@@ -15,6 +15,12 @@ at 1x/2x/4x time compression:
   the grow threshold (at least one grow event), and the idle tail
   after the burst drives occupancy to zero (at least one shrink, the
   drained replica's requests completing elsewhere).
+- **4x, disaggregated 1 prefill + 1 decode vs 2 pooled** — role-split
+  replicas (``FleetConfig(roles=...)``) with codec-compressed KV-page
+  shipping (fp8 wire leg + raw fp32 control).  The acceptance bars:
+  disaggregated TTFT p99 strictly below the 2-pooled-replica baseline
+  at 4x, KV pages genuinely shipped on both codec legs, and fp8 wire
+  bytes >= 3x under raw.
 - **prefix reuse** — each tenant group shares a system prompt, so the
   fleet's ``prefill tokens computed vs requested`` ratio must come out
   nonzero.
@@ -72,8 +78,16 @@ def record_trace(path: str, requests: int = 64, seed: int = 0,
         tenant = tenants[i % len(tenants)]
         shared = groups[tenant]
         suffix = rng.integers(1, 100, size=int(rng.integers(3, 9)))
-        prompt = suffix if shared is None \
-            else np.concatenate([shared, suffix])
+        if shared is None:
+            # cold tenant: no shared prefix (nothing for the prefix
+            # cache), but still a page-sized prompt — every request
+            # owns >= 1 whole page, so the cold path rides every
+            # serving mode including disaggregation (sub-page prompts
+            # are covered by tests/test_fleet.py)
+            prompt = np.concatenate(
+                [rng.integers(1, 100, size=PAGE_SIZE), suffix])
+        else:
+            prompt = np.concatenate([shared, suffix])
         trace.append({
             # front-loaded: 70% of arrivals in the first half
             "at": round(float(rng.beta(1.2, 2.0)) * duration_s, 4),
@@ -203,10 +217,38 @@ def run_fleet_ab(metric: str, requests: int = 64,
     try:
         legs["fleet2_1x"] = replay(fleet2, trace, 1.0)
         legs["fleet2_2x"] = replay(fleet2, trace, 2.0)
+        # the 4x burst is the disaggregation baseline: same 2 replicas,
+        # both pooled, slots held hostage by 14-token decode tails
+        legs["pooled2_4x"] = replay(fleet2, trace, 4.0)
         fleet2_pages = fleet2.pages_stats()
         fleet2_status = fleet2.status()["fleet"]
     finally:
         fleet2.shutdown()
+
+    # -- disaggregated: 1 prefill + 1 decode replica, KV pages ship ----
+    # over the peer channel.  The prefill replica's slots free after
+    # ONE token (no decode tail), so burst admissions stop queueing
+    # behind held slots — the TTFT-p99 win the 4x comparison pins.
+    # fp8 is the compressed wire leg; raw (fp32) is the A/B control.
+    disagg_status = {}
+    for codec in ("fp8", "raw"):
+        dis = FleetServer(
+            GPTLightningModule(cfg), replicas=2, autoscale=False,
+            fleet={"roles": ("prefill", "decode"),
+                   "kvship_codec": codec},
+            paged={"page_size": PAGE_SIZE},
+            default_root_dir=os.path.join(root, f"disagg_{codec}"),
+            **server_kw).start()
+        try:
+            # warm pass (discarded): the pooled2 baseline replays 1x
+            # and 2x before ITS timed 4x leg, so its programs, donors
+            # and pools are hot — the A/B is only fair if the disagg
+            # fleet starts its timed leg equally warm
+            replay(dis, trace, 1.0)
+            legs[f"disagg_{codec}_4x"] = replay(dis, trace, 4.0)
+            disagg_status[codec] = dis.status()["fleet"]
+        finally:
+            dis.shutdown()
 
     # -- autoscaling fleet under the 4x burst --------------------------
     auto = FleetServer(
@@ -266,7 +308,21 @@ def run_fleet_ab(metric: str, requests: int = 64,
                    "fleet2": _slim(legs["fleet2_1x"])},
             "2x": {"single": _slim(legs["single_2x"]),
                    "fleet2": _slim(legs["fleet2_2x"])},
-            "4x": {"autoscale": _slim(legs["auto_4x"])},
+            "4x": {"autoscale": _slim(legs["auto_4x"]),
+                   "pooled2": _slim(legs["pooled2_4x"]),
+                   "disagg": _slim(legs["disagg_fp8_4x"]),
+                   "disagg_raw": _slim(legs["disagg_raw_4x"])},
+        },
+        "disagg": {
+            "roles": ["prefill", "decode"],
+            "ttft_p99_ms": legs["disagg_fp8_4x"]["ttft_p99_ms"],
+            "pooled2_ttft_p99_ms": legs["pooled2_4x"]["ttft_p99_ms"],
+            "kvship": {c: disagg_status[c]["kvship"]
+                       for c in disagg_status},
+            # fp8's own raw-baseline ratio (bytes_raw is the fp32 size
+            # of the same shipped rows — the raw control leg's wire)
+            "fp8_compression_ratio":
+                disagg_status["fp8"]["kvship"]["compression_ratio"],
         },
         "autoscale": {
             "events": autoscale["events"],
@@ -296,6 +352,16 @@ def run_fleet_ab(metric: str, requests: int = 64,
         fleet_doc["prefix_reuse"]
     assert fleet_doc["requests_lost"] == 0, fleet_doc["failovers"]
     assert parity["ok"], parity
+    # disaggregation bars: prefill/decode split beats 2 pooled replicas
+    # on 4x-burst TTFT p99; KV pages genuinely shipped; fp8 rides the
+    # wire at >= 3x under the raw (fp32) control leg
+    dis = fleet_doc["disagg"]
+    assert dis["ttft_p99_ms"] < dis["pooled2_ttft_p99_ms"], dis
+    for codec, kv in dis["kvship"].items():
+        assert kv["ships"] > 0, (codec, kv)
+    assert dis["fp8_compression_ratio"] >= 3.0, dis
+    assert all(st["failed"] == 0 for st in disagg_status.values()), \
+        disagg_status
     return [record]
 
 

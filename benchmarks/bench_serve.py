@@ -39,7 +39,8 @@ def _percentile_ms(vals) -> "dict[str, float]":
 
 
 def _run_leg(module, *, telemetry, requests, slots, max_new_tokens,
-             buckets, num_workers, platform, vocab_size, root):
+             buckets, num_workers, platform, vocab_size, root,
+             spec=None):
     """One timed serve leg; returns (wall_s, reqs, stats)."""
     from ray_lightning_tpu.serve import Server
     server = Server(
@@ -50,6 +51,7 @@ def _run_leg(module, *, telemetry, requests, slots, max_new_tokens,
         default_root_dir=root,
         compile_cache=None,   # RLT_COMPILE_CACHE* env knobs apply
         telemetry=telemetry,
+        spec=spec,
     ).start()
     rng = np.random.default_rng(0)
     tenants = ("alice", "bob", "carol")
@@ -145,6 +147,53 @@ def main() -> None:
         "tracing": True,
         "per_tenant": tenant_rows,
     }
+
+    if os.environ.get("RLT_SPEC_BENCH", "1") != "0":
+        # speculative-decoding leg: same workload with a layer-truncated
+        # draft model drafting k tokens per round and ONE target forward
+        # verifying them.  The CPU-proxy win metric is tokens per target
+        # forward (> 1 means speculation amortized target compute —
+        # CPU wall-clock is draft-dominated because every forward costs
+        # the same here; on TPU the draft forwards are proportionally
+        # cheap and the proxy converts into wall-clock tokens/s).
+        spec_cfg = {
+            "k": int(os.environ.get("RLT_SPEC_K", "4") or 4),
+            "draft_layers": int(
+                os.environ.get("RLT_SPEC_DRAFT_LAYERS", "0") or 0),
+            "min_accept": float(
+                os.environ.get("RLT_SPEC_MIN_ACCEPT", "0.1") or 0.1),
+        }
+        if os.environ.get("RLT_DRAFT_QUANT", "").strip():
+            spec_cfg["draft_quant"] = os.environ["RLT_DRAFT_QUANT"].strip()
+        wall_sp, reqs_sp, outs_sp, stats_sp = _run_leg(
+            GPTLightningModule(args.config), telemetry=False,
+            spec=spec_cfg, **leg)
+        for o, o2 in zip(outs, outs_sp):
+            assert list(o) == list(o2), "spec decode broke greedy parity"
+        sp = stats_sp["scheduler"]["spec"]
+        sp_workers = stats_sp.get("workers", [])
+        sp_retraces = (max(sum(w["retraces"].values())
+                           for w in sp_workers) if sp_workers else None)
+        serve["spec"] = {
+            "tokens_per_sec": round(
+                sum(len(o) for o in outs_sp) / wall_sp, 2),
+            "k": sp["k"],
+            "acceptance_rate": sp["acceptance_rate"],
+            "tokens_per_target_forward": sp["tokens_per_target_forward"],
+            "drafted": sp["drafted"],
+            "accepted": sp["accepted"],
+            "fallbacks": sp["fallbacks"],
+            "draft_quant": spec_cfg.get("draft_quant"),
+            "retraces_after_warmup": sp_retraces,
+        }
+        if sp_workers and "spec" in sp_workers[0]:
+            # draft-weight residency (int8 quant satellite): the HBM
+            # delta vs a dedicated bf16 draft copy
+            serve["spec"]["draft_hbm_delta_bytes"] = \
+                sp_workers[0]["spec"].get("draft_hbm_delta_bytes")
+        assert sp["tokens_per_target_forward"] > 1.0, sp
+        if sp_retraces is not None:
+            assert sp_retraces == 0, f"spec programs retraced: {sp_workers}"
 
     if os.environ.get("RLT_SERVE_TRACE_AB") == "1":
         # A/B leg with telemetry (and therefore per-request tracing)

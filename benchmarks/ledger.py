@@ -51,6 +51,19 @@ figure                          worse    band
                                         steps/sec delta, gated even
                                         without a previous round —
                                         bench_incident.py A/B leg)
+``serve.spec.acceptance_rate``
+/ ``...tokens_per_target_
+forward``                       lower   ``serve_band`` + 5-point
+                                        acceptance floor (draft-quality
+                                        collapse is a regression even
+                                        while tokens/s holds —
+                                        bench_serve.py spec leg)
+``fleet.disagg.ttft_p99_ms``    higher  ``serve_band`` + ``min_ttft_ms``
+                                        (the 4x-burst prefill/decode
+                                        split leg, bench_fleet.py)
+``fleet.disagg.fp8_
+compression_ratio``             lower   ``serve_band`` (KV wire bytes
+                                        vs the raw fp32 control)
 ==============================  ======  ==============================
 
 Improvements are reported too (the ledger is a trajectory, not just an
@@ -89,6 +102,9 @@ GOODPUT_BAND = 0.10
 #: absolute goodput-fraction / bubble-fraction floor: drift smaller
 #: than 2 points of fraction is wall-clock noise, not a regression
 MIN_GOODPUT_DELTA = 0.02
+#: absolute spec-decode acceptance floor: under 5 points of
+#: accepted/drafted drift is workload mix, not draft-model regression
+MIN_ACCEPT_DELTA = 0.05
 #: detector-overhead ceiling (telemetry/incident.py): the incident
 #: plane runs on every fit, so its measured on-vs-off step-wall cost
 #: (benchmarks/bench_incident.py) is gated ABSOLUTELY at 2%
@@ -224,6 +240,37 @@ def compare(prev: Any, curr: Any, *, step_band: float = STEP_BAND,
             check(metric, f"{key}.tpot_p50_ms", ps.get("tpot_p50_ms"),
                   cs.get("tpot_p50_ms"), "higher", serve_band,
                   floor=min_tpot_ms)
+            # speculative decode (bench_serve.py spec leg): an
+            # acceptance-rate collapse or a tokens-per-target-forward
+            # slide is a draft-quality regression even while wall-clock
+            # tokens/s holds on the CPU proxy
+            psp = ps.get("spec") if isinstance(ps.get("spec"), dict) \
+                else {}
+            csp = cs.get("spec") if isinstance(cs.get("spec"), dict) \
+                else {}
+            if psp or csp:
+                check(metric, f"{key}.spec.acceptance_rate",
+                      psp.get("acceptance_rate"),
+                      csp.get("acceptance_rate"), "lower", serve_band,
+                      floor=MIN_ACCEPT_DELTA)
+                check(metric, f"{key}.spec.tokens_per_target_forward",
+                      psp.get("tokens_per_target_forward"),
+                      csp.get("tokens_per_target_forward"), "lower",
+                      serve_band)
+            # disaggregated decode (bench_fleet.py disagg legs): the
+            # split-pool TTFT tail and the fp8 wire-compression ratio
+            pd = ps.get("disagg") if isinstance(ps.get("disagg"), dict) \
+                else {}
+            cd = cs.get("disagg") if isinstance(cs.get("disagg"), dict) \
+                else {}
+            if pd or cd:
+                check(metric, f"{key}.disagg.ttft_p99_ms",
+                      pd.get("ttft_p99_ms"), cd.get("ttft_p99_ms"),
+                      "higher", serve_band, floor=min_ttft_ms)
+                check(metric, f"{key}.disagg.fp8_compression_ratio",
+                      pd.get("fp8_compression_ratio"),
+                      cd.get("fp8_compression_ratio"), "lower",
+                      serve_band)
         # goodput plane (telemetry/goodput.py `goodput` dict): the
         # useful-fraction of run wall and measured MFU are both
         # lower-is-worse; one-sided presence (a pre-goodput baseline)

@@ -220,9 +220,12 @@ def decompress_cast(q: jax.Array, scale, mode: str,
 def payload_bytes(n_elements: int, mode: str, block_size: int = 64) -> int:
     """Wire bytes one rank's ``n_elements`` payload occupies compressed
     (1-byte data + fp32 per-block scales for int8/fp8; int4 packs two
-    elements per byte; bf16 has no scales).  Used by the strategies'
+    elements per byte; bf16 has no scales; ``raw`` — and any other
+    uncompressed mode — charges fp32).  Used by the strategies'
     ``step_collective_bytes`` so the metrics plane charges the
-    *compressed* traffic."""
+    *compressed* traffic, and by the fleet's KV-ship accounting
+    (serve/fleet/router.py) so codec savings are measured in the same
+    units as the raw A/B control leg."""
     if mode == "bf16":
         return 2 * n_elements
     n_blocks = -(-n_elements // block_size)
@@ -231,3 +234,43 @@ def payload_bytes(n_elements: int, mode: str, block_size: int = 64) -> int:
     if mode == "int4":
         return -(-n_elements // 2) + 4 * n_blocks
     return 4 * n_elements
+
+
+def quantize_blob(x, mode: str, block_size: int = 64):
+    """Shape-agnostic ``(payload, scale)`` encode for whole tensors.
+
+    The blockwise kernels above require the last dim to divide
+    ``block_size`` (they view a wire payload whose length the comm plane
+    controls).  Arbitrary model/KV tensors don't oblige, so this wrapper
+    flattens to 1-D and zero-pads up to a block multiple before
+    encoding; :func:`dequantize_blob` strips the pad.  ``mode="raw"``
+    passes through untouched (the A/B control leg of KV shipping).  Used
+    for int8 draft-weight residency (serve/engine.py) and the fp8/int4
+    KV-page ship codecs (serve/fleet/router.py) — both settings where
+    the tensor, not a wire chunk, is the unit."""
+    x = jnp.asarray(x)
+    if mode == "raw":
+        # fp32 on the wire: raw is the UNCOMPRESSED control leg, so it
+        # must cost the full 4 bytes/element the codec ratios are
+        # measured against (payload_bytes' fallback row).
+        return x.astype(jnp.float32), None
+    flat = x.reshape(-1).astype(jnp.float32)
+    pad = (-flat.shape[0]) % block_size
+    if pad and mode != "bf16":
+        flat = jnp.pad(flat, (0, pad))
+    return compress_cast(flat, mode, block_size)
+
+
+def dequantize_blob(payload, scale, mode: str, shape,
+                    block_size: int = 64, dtype=jnp.float32):
+    """Decode matching :func:`quantize_blob`: unpad, reshape to
+    ``shape``, cast to ``dtype``.  Pure ``jax.numpy`` — traces into
+    jitted programs (the draft step dequantizes resident int8 weights
+    inline) and runs eagerly host-side (KV-ship import)."""
+    if mode == "raw":
+        return jnp.asarray(payload).reshape(shape).astype(dtype)
+    flat = decompress_cast(payload, scale, mode, block_size)
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return flat[:n].reshape(shape).astype(dtype)

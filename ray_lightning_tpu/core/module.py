@@ -224,6 +224,21 @@ class LightningModule:
         dropout) the way GPTLightningModule does."""
         return self.configure_model()
 
+    def configure_draft(self, layers: "int | None" = None):
+        """Speculative-decode hook (serve/engine.py): a smaller sibling
+        flax module — fewer layers/heads, SAME tokenizer and param
+        naming — whose param tree is a subtree of this module's, used
+        as the draft model of the draft→verify speculative-decode loop.
+        Must expose the same ``__call__`` (draft prefill) and
+        ``decode`` surface as :meth:`configure_decode_model`'s module.
+        ``layers`` optionally overrides the draft depth
+        (``RLT_SPEC_DRAFT_LAYERS`` rides in through ``SpecConfig``,
+        serve/spec.py).  Default: ``None`` — no draft available, the
+        engine refuses ``spec=`` rather than silently serving without
+        speculation.  See models/gpt.py for the layer-truncated
+        weight-sharing reference implementation."""
+        return None
+
     def configure_remat(self):
         """Planner-plane remat hook (core/remat.py): a ``RematSpec``
         describing this module's rematerialization ladder — which

@@ -203,7 +203,8 @@ def build_train_step(module, tx,
     return step_fn
 
 
-def build_prefill_step(module, bucket_len: int) -> Callable:
+def build_prefill_step(module, bucket_len: int, model=None,
+                       dequant=None) -> Callable:
     """Serve-plane prefill program for ONE sequence-length bucket
     (sibling of :func:`build_train_step`; consumed by serve/engine.py).
 
@@ -217,11 +218,19 @@ def build_prefill_step(module, bucket_len: int) -> Callable:
     positions ``>= length`` hold pad garbage the causal mask keeps out
     of the first token's logits and :func:`cached_attention`'s position
     bound keeps out of every later one.
+
+    ``model`` overrides the forward module (the DRAFT model's prefill
+    over the draft KV cache, speculative decoding); ``dequant`` maps
+    the params argument inside the traced body (int8-resident draft
+    weights decode inline, comm/quant.py ``dequantize_blob``).
     """
     module.setup_model()
-    model = module.configure_decode_model()
+    if model is None:
+        model = module.configure_decode_model()
 
     def step_fn(params, k_caches, v_caches, tokens, slot, length):
+        if dequant is not None:
+            params = dequant(params)
         logits, captured = model.apply({"params": params}, tokens, True,
                                        mutable=["kv_cache"])
         first = jnp.argmax(
@@ -295,6 +304,81 @@ def build_decode_step(module, page_table=None) -> Callable:
         logits, new_k, new_v = model.apply(
             {"params": params}, tokens, positions, k_caches, v_caches,
             method="decode", **kw)
+        return new_k, new_v, jnp.argmax(logits, axis=-1).astype(
+            tokens.dtype)
+
+    return step_fn
+
+
+def build_draft_step(module, k: int, page_table=None, model=None,
+                     dequant=None) -> Callable:
+    """Speculative-decode draft program: ``k`` autoregressive greedy
+    decode steps of the DRAFT model, unrolled into ONE compiled
+    program over its own (smaller) KV cache.
+
+    ``(draft_params, dk_caches, dv_caches, tokens, positions) ->
+    (dk', dv', drafts)``: ``tokens``/``positions`` are the [S] last
+    emitted token per slot at its position (exactly the plain-decode
+    inputs); ``drafts`` is [S, k] — the k greedily drafted tokens per
+    slot.  Each unrolled step writes its token's draft-cache row and
+    feeds its argmax forward, so after the step the draft cache holds
+    rows ``[0, pos+k)``; rows drafted past the verify's accepted
+    prefix are stale-but-masked and the NEXT round (restarting at the
+    corrected position) overwrites them — same induction as the target
+    cache (models/gpt.py ``GPT.verify``).
+
+    ``model`` is the draft flax module
+    (``LightningModule.configure_draft()``); ``dequant`` decodes
+    int8-resident draft params inline (``RLT_DRAFT_QUANT``).
+    """
+    module.setup_model()
+    if model is None:
+        model = module.configure_decode_model()
+    kw = {} if page_table is None else {
+        "page_table": jnp.asarray(page_table, jnp.int32)}
+
+    def step_fn(params, dk_caches, dv_caches, tokens, positions):
+        if dequant is not None:
+            params = dequant(params)
+        toks, pos, drafts = tokens, positions, []
+        for _ in range(k):
+            logits, dk_caches, dv_caches = model.apply(
+                {"params": params}, toks, pos, dk_caches, dv_caches,
+                method="decode", **kw)
+            toks = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+            pos = pos + 1
+            drafts.append(toks)
+        return dk_caches, dv_caches, jnp.stack(drafts, axis=1)
+
+    return step_fn
+
+
+def build_verify_step(module, k: int, page_table=None) -> Callable:
+    """Speculative-decode verify program: ONE batched target forward
+    over the k drafted positions per slot.
+
+    ``(params, k_caches, v_caches, tokens, positions) ->
+    (k', v', argmaxes)`` with ``tokens``/``positions`` [S, k+1] — per
+    slot the last emitted token followed by its k drafts at
+    consecutive positions.  ``argmaxes`` [S, k+1]: column j is the
+    token the target would emit after the prefix extended by drafts
+    ``1..j`` — the scheduler accepts the longest prefix where
+    ``draft[j] == argmax[j]`` plus the one corrected token
+    (serve/scheduler.py), which makes speculative output token-level
+    IDENTICAL to target-only greedy decode.  Rides
+    :meth:`models.gpt.GPT.verify`'s multi-query cached attention, so
+    the flash-decode/paged kernels and per-query length masks are the
+    plain decode path's.
+    """
+    module.setup_model()
+    model = module.configure_decode_model()
+    kw = {} if page_table is None else {
+        "page_table": jnp.asarray(page_table, jnp.int32)}
+
+    def step_fn(params, k_caches, v_caches, tokens, positions):
+        logits, new_k, new_v = model.apply(
+            {"params": params}, tokens, positions, k_caches, v_caches,
+            method="verify", **kw)
         return new_k, new_v, jnp.argmax(logits, axis=-1).astype(
             tokens.dtype)
 

@@ -272,6 +272,42 @@ class GPT(nn.Module):
         logits = self.wte.attend(x).astype(jnp.float32)
         return logits[:, 0], jnp.stack(new_k), jnp.stack(new_v)
 
+    def verify(self, tokens, positions, k_caches, v_caches,
+               page_table=None):
+        """Multi-token decode over ``S`` slots — the speculative-decode
+        verify forward (core/steps.py ``build_verify_step``).
+
+        ``tokens`` / ``positions`` [S, T] int32 — per slot, the last
+        emitted token followed by the k drafted tokens at consecutive
+        positions (T = k+1); caches as in :meth:`decode`.  ONE batched
+        target forward writes every query's K/V row and scores each
+        query under its own position bound (ops/attention.py
+        multi-query ``cached_attention``), so the argmax at query j is
+        numerically THE token plain decode would emit after accepting
+        drafts 1..j — greedy parity is exact by construction, not by
+        tolerance.  Rows written for later-rejected drafts are stale
+        but masked (never at or below any live query's bound) and are
+        overwritten by the next round, which restarts at the first
+        corrected position.  Returns ``(logits [S, T, V] fp32, new_k,
+        new_v)``.
+        """
+        cfg = self.config
+        x = self.wte(tokens)
+        # gather clamps out-of-range positions (slots speculating past
+        # the cache end read wpe[-1]; their outputs are truncated by the
+        # scheduler's max_new cap before anything is emitted)
+        x = x + jnp.take(self.wpe, positions, axis=0).astype(cfg.dtype)
+        new_k, new_v = [], []
+        for i, blk in enumerate(self.blocks):
+            x, (k, v) = blk(x, True,
+                            decode_cache=(k_caches[i], v_caches[i]),
+                            positions=positions, page_table=page_table)
+            new_k.append(k)
+            new_v.append(v)
+        x = self.ln_f(x)
+        logits = self.wte.attend(x).astype(jnp.float32)
+        return logits, jnp.stack(new_k), jnp.stack(new_v)
+
 
 def gpt_partition_rules(tensor_axis: str = "tensor") -> list[tuple[str, P]]:
     """SpmdStrategy rules for a (data, [fsdp,] tensor) mesh.
@@ -425,6 +461,32 @@ class GPTLightningModule(LightningModule):
                 "routing is batch-shaped and has no single-token cache "
                 "path yet (models/gpt.py GPT.decode)")
         return GPT(dataclasses.replace(self.config, remat=False,
+                                       dropout=0.0))
+
+    def configure_draft(self, layers: "int | None" = None):
+        """Speculative-decode draft sibling (serve/engine.py): the SAME
+        architecture truncated to the first ``layers`` blocks (default
+        ``n_layer // 2``), sharing the target's weights — ``wte``,
+        ``wpe``, ``h0..h{layers-1}`` and ``ln_f`` are a subtree of the
+        target param tree, so the engine derives draft params by path
+        with ZERO extra HBM (unless ``RLT_DRAFT_QUANT`` opts into an
+        int8 resident copy).  A layer-truncated residual LM is the
+        classic self-speculation draft: early blocks carry most of the
+        next-token signal, so acceptance is real without any separate
+        draft training.  ``layers == n_layer`` is the degenerate
+        full-clone draft (acceptance 1.0 — the test fixture for the
+        accept-k pattern)."""
+        if self.config.n_experts > 0:
+            raise ValueError(
+                "speculative decode does not support MoE configs: the "
+                "draft/verify path rides GPT.decode/verify, which "
+                "reject expert routing (configure_decode_model)")
+        cfg = self.config
+        n = int(layers) if layers else max(1, cfg.n_layer // 2)
+        if not 1 <= n <= cfg.n_layer:
+            raise ValueError(
+                f"draft layers {n} must be in [1, {cfg.n_layer}]")
+        return GPT(dataclasses.replace(cfg, n_layer=n, remat=False,
                                        dropout=0.0))
 
     @property

@@ -132,10 +132,30 @@ def cached_attention(q, k_cache, v_cache, positions, *,
     ([S, pages_per_slot] int32, serve/fleet/pages.py) so the fetch is
     page-indirect.  Unsupported geometry falls back to dense — same
     numbers, no surprise crash on odd head shapes.
+
+    Multi-query form (speculative-decode verify, core/steps.py
+    ``build_verify_step``): ``q`` [S, T, H, D] with ``positions``
+    [S, T] — T queries per slot at consecutive positions, each masked
+    to its OWN position bound, so one batched target forward scores all
+    T drafted tokens under exactly the masks T sequential decode steps
+    would have used.  Lowered as T single-query attentions (each free
+    to take the flash/paged kernel) — T is the small speculation depth
+    k+1, and this keeps the per-query length masking identical to plain
+    decode, which is what makes greedy parity exact by construction.
     """
     from ray_lightning_tpu.ops.flash_decode import (
         NEG_INF, decode_kernel_supported, flash_decode_attention,
         resolve_decode_impl)
+
+    if positions.ndim == 2:
+        if q.shape[1] == 1:
+            positions = positions[:, 0]
+        else:
+            return jnp.concatenate(
+                [cached_attention(q[:, j:j + 1], k_cache, v_cache,
+                                  positions[:, j], dtype=dtype, impl=impl,
+                                  page_table=page_table)
+                 for j in range(q.shape[1])], axis=1)
 
     impl = resolve_decode_impl(impl)
     if impl == "paged" and page_table is None:
@@ -224,8 +244,21 @@ class MultiHeadAttention(nn.Module):
             # ServeWorker.serve_step dispatches decode before prefills.
             k_cache, v_cache = decode_cache
             slots = jnp.arange(B)
-            k_cache = k_cache.at[slots, positions].set(k[:, 0])
-            v_cache = v_cache.at[slots, positions].set(v[:, 0])
+            if T == 1:
+                k_cache = k_cache.at[slots, positions].set(k[:, 0])
+                v_cache = v_cache.at[slots, positions].set(v[:, 0])
+            else:
+                # multi-query verify (T = speculation depth k+1,
+                # positions [B, T]): write every query's K/V first,
+                # then attend each query under its own position bound
+                # (cached_attention's multi-query form) — causal by the
+                # bound, so query j never sees rows j+1..T-1.  Rows at
+                # positions >= L (slots speculating past the cache end,
+                # and the paging dummy row's +j offsets) are DROPPED by
+                # jax's out-of-bounds scatter semantics — no per-slot
+                # gating, no shape change, no retrace.
+                k_cache = k_cache.at[slots[:, None], positions].set(k)
+                v_cache = v_cache.at[slots[:, None], positions].set(v)
             y = cached_attention(q, k_cache, v_cache, positions,
                                  dtype=self.dtype, page_table=page_table)
             y = nn.Dense(C, dtype=self.dtype,

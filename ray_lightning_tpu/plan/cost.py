@@ -284,3 +284,38 @@ def rank_key(candidate: Candidate, est: Estimate) -> tuple:
     collective)."""
     mismatch = 0 if candidate.donate == est.donate_preferred else 1
     return (est.step_seconds, mismatch, est.peak_bytes, candidate.label)
+
+
+def expected_accepted(acceptance: float, k: int) -> float:
+    """Expected draft tokens accepted per spec-decode round at
+    per-token acceptance probability ``acceptance`` and depth ``k``:
+    the mean of the truncated geometric run-length,
+    ``sum_{m=1..k} a^m = a(1 - a^k)/(1 - a)``.  The verify's corrected
+    token rides on top, so tokens-per-target-forward is
+    ``1 + expected_accepted`` — the serve plane's measured
+    ``tokens_per_target_forward`` converges to this (scheduler spec
+    block; serve/selfcheck.py pins the shape)."""
+    a = min(1.0, max(0.0, float(acceptance)))
+    k = max(1, int(k))
+    if a >= 1.0:
+        return float(k)
+    return a * (1.0 - a ** k) / (1.0 - a)
+
+
+def speculative_speedup(acceptance: float, k: int,
+                        draft_cost_ratio: float) -> float:
+    """Modeled wall-clock speedup of speculative decoding over plain
+    decode.  One spec round emits ``1 + expected_accepted`` tokens for
+    the price of one target forward plus ``k`` draft forwards, each
+    ``draft_cost_ratio`` of a target forward (layer-truncated drafts:
+    roughly ``draft_layers / n_layer``).  Plain decode pays one target
+    forward per token, so::
+
+        speedup = (1 + E[accepted]) / (1 + k * draft_cost_ratio)
+
+    < 1 means speculation LOSES at this operating point (acceptance
+    collapsed or the draft is too expensive) — the scheduler's
+    ``min_accept`` fallback exists precisely for that regime."""
+    r = max(0.0, float(draft_cost_ratio))
+    return (1.0 + expected_accepted(acceptance, k)) \
+        / (1.0 + max(1, int(k)) * r)

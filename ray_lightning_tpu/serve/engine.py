@@ -38,9 +38,11 @@ import numpy as np
 from ray_lightning_tpu.compile import AotPrecompiler
 from ray_lightning_tpu.core.steps import (
     build_decode_step,
+    build_draft_step,
     build_kv_copy,
     build_prefill_step,
     build_suffix_step,
+    build_verify_step,
     kv_layer_pairs,
 )
 from ray_lightning_tpu.serve.kvcache import KVCacheSpec
@@ -54,7 +56,8 @@ class ServeEngine:
 
     def __init__(self, module, strategy, buckets: Sequence[int],
                  slots: int, max_seq_len: int, seed: int = 0,
-                 weights: Optional[dict] = None, paged: Any = None):
+                 weights: Optional[dict] = None, paged: Any = None,
+                 spec: Any = None, kvship: bool = False):
         self.module = module
         self.strategy = strategy
         self.buckets = tuple(buckets)
@@ -67,6 +70,16 @@ class ServeEngine:
         #: programs that make prefix-cache hits executable
         self.paged = paged if paged is not None and paged.enabled \
             else None
+        #: SpecConfig (serve/spec.py) — when enabled the engine builds
+        #: the draft plane: a draft param subtree + its own KV cache,
+        #: one draft prefill per bucket, the k-step draft program and
+        #: the batched verify program
+        self.spec = spec if spec is not None and spec.enabled else None
+        #: build per-bucket kv_import programs so cross-replica KV-page
+        #: shipping (serve/fleet/router.py) can install donor rows; a
+        #: flag (not default-on) so non-fleet engines keep their exact
+        #: pre-existing program count
+        self.kvship = bool(kvship)
         #: which decode attention kernel the compiled program uses —
         #: dense | flash_decode | paged (resolved at setup from
         #: RLT_DECODE_IMPL, ops/flash_decode.py); benches emit it so a
@@ -83,6 +96,25 @@ class ServeEngine:
         self._kv_init = None
         self._k = None
         self._v = None
+        # draft plane (spec decode)
+        self.draft_kv_spec: Optional[KVCacheSpec] = None
+        self.draft_layers = 0
+        self._draft_model = None
+        self._draft_params = None
+        self._draft_prefills: dict[int, Any] = {}
+        self._draft = None
+        self._verify = None
+        self._dkv_init = None
+        self._dk = None
+        self._dv = None
+        #: extra HBM the draft residency holds (0 = pure weight-sharing
+        #: views of the target tree; int8 quant holds payload+scales)
+        self.draft_resident_bytes = 0
+        #: what a standalone bf16 copy of the draft tree would cost —
+        #: the baseline the HBM delta in stats() is measured against
+        self.draft_fp_bytes = 0
+        # kv-ship plane
+        self._kv_imports: dict[int, Any] = {}
 
     # -- setup -------------------------------------------------------------
 
@@ -211,6 +243,137 @@ class ServeEngine:
             self._kv_copy = jax.jit(
                 self._counted("kv_copy", build_kv_copy()), **ckw)
 
+        if self.spec is not None:
+            # -- draft plane (speculative decoding, serve/spec.py) ---------
+            draft_model = module.configure_draft(
+                self.spec.draft_layers or None)
+            if draft_model is None:
+                raise ValueError(
+                    f"spec= requires {type(module).__name__}."
+                    f"configure_draft() to return a draft module "
+                    f"(core/module.py hook); it returned None")
+            self._draft_model = draft_model
+            self.draft_layers = getattr(
+                getattr(draft_model, "config", None), "n_layer", 0)
+            d_abstract = jax.eval_shape(
+                draft_model.init, jax.random.PRNGKey(0), dummy)["params"]
+
+            def _subtree(target, aval, path=""):
+                """Draft params BY PATH out of the target tree — the
+                weight-sharing contract: every draft param is the
+                target's same-named array (zero extra HBM)."""
+                if isinstance(aval, dict):
+                    out = {}
+                    for name, sub in aval.items():
+                        if name not in target:
+                            raise ValueError(
+                                f"draft param {path + name!r} missing "
+                                f"from the target tree: "
+                                f"configure_draft() must share the "
+                                f"target's param naming")
+                        out[name] = _subtree(target[name], sub,
+                                             path + name + "/")
+                    return out
+                if tuple(target.shape) != tuple(aval.shape):
+                    raise ValueError(
+                        f"draft param {path!r}: shape {aval.shape} != "
+                        f"target {target.shape}")
+                return target
+
+            draft_params = _subtree(self.params, d_abstract)
+            self.draft_fp_bytes = int(sum(
+                int(np.prod(a.shape)) * 2
+                for a in jax.tree_util.tree_leaves(d_abstract)))
+            dequant = None
+            if self.spec.draft_quant == "int8":
+                # int8 residency (RLT_DRAFT_QUANT): hold the draft tree
+                # as blockwise (payload, scale) pairs, dequantized
+                # INSIDE the draft programs (comm/quant.py).  Trades
+                # the zero-cost views for a ~2x-smaller standalone copy
+                # whose bytes stay resident even if the target tree is
+                # later offloaded; the measured delta rides stats().
+                from ray_lightning_tpu.comm.quant import (
+                    dequantize_blob, quantize_blob)
+                flat, treedef = jax.tree_util.tree_flatten(draft_params)
+                shapes = [tuple(a.shape) for a in flat]
+                dtypes = [a.dtype for a in flat]
+                qflat = [tuple(quantize_blob(a, "int8")) for a in flat]
+                self._draft_params = qflat
+                self.draft_resident_bytes = int(sum(
+                    p.nbytes + s.nbytes for p, s in qflat))
+
+                def dequant(qleaves):
+                    leaves = [
+                        dequantize_blob(p, s, "int8", shape, dtype=dt)
+                        for (p, s), shape, dt in zip(qleaves, shapes,
+                                                     dtypes)]
+                    return jax.tree_util.tree_unflatten(treedef, leaves)
+            else:
+                self._draft_params = draft_params
+
+            # draft KV geometry from an abstract draft prefill capture
+            _, dcap = jax.eval_shape(
+                lambda p, t: draft_model.apply(
+                    {"params": p}, t, True, mutable=["kv_cache"]),
+                d_abstract, dummy)
+            dk_avals = [a for a, _ in kv_layer_pairs(dcap["kv_cache"])]
+            self.draft_kv_spec = KVCacheSpec.from_capture(
+                dk_avals, self.slots, self.max_seq_len)
+            d_shape = self.draft_kv_spec.shape
+
+            def dkv_init():
+                z = jnp.zeros(d_shape, kv_dtype)
+                return z, z
+
+            self._dkv_init = jax.jit(
+                self._counted("draft_kv_init", dkv_init), **kkw)
+
+            def jit_draft(name, fn):
+                # no in_shardings pin: the draft param tree is NOT the
+                # target tree (subtree, possibly quantized pairs) — jax
+                # reads the resident shardings of the shared views
+                kw: dict = {"donate_argnums": (1, 2)}
+                if multi:
+                    kw["out_shardings"] = (kv_sh, kv_sh, rep)
+                return jax.jit(self._counted(name, fn), **kw)
+
+            for b in self.buckets:
+                self._draft_prefills[b] = jit_draft(
+                    f"draft_prefill_{b}",
+                    build_prefill_step(module, b, model=draft_model,
+                                       dequant=dequant))
+            self._draft = jit_draft(
+                "draft",
+                build_draft_step(module, self.spec.k,
+                                 page_table=page_table,
+                                 model=draft_model, dequant=dequant))
+            self._verify = jit_step(
+                "verify",
+                build_verify_step(module, self.spec.k,
+                                  page_table=page_table), 2)
+
+        if self.kvship:
+            # -- KV-page import programs (fleet disaggregation) ------------
+            # one per bucket: install shipped donor rows [0, b) at a
+            # slot with a single dynamic_update_slice per cache — the
+            # device half of cross-replica prefix donation
+            # (serve/fleet/router.py ships, PrefixIndex addresses)
+            def import_fn(k_caches, v_caches, ks, vs, slot):
+                zero = (0,) * (k_caches.ndim - 2)
+                k_caches = jax.lax.dynamic_update_slice(
+                    k_caches, ks, (0, slot) + zero)
+                v_caches = jax.lax.dynamic_update_slice(
+                    v_caches, vs, (0, slot) + zero)
+                return k_caches, v_caches
+
+            for b in self.buckets:
+                ikw2: dict = {"donate_argnums": (0, 1)}
+                if multi:
+                    ikw2["in_shardings"] = (kv_sh, kv_sh, rep, rep, rep)
+                    ikw2["out_shardings"] = (kv_sh, kv_sh)
+                self._kv_imports[b] = jax.jit(
+                    self._counted(f"kv_import_{b}", import_fn), **ikw2)
+
         # AOT avals must describe the params AS SERVED (post
         # param_dtype cast / restore), not the fp32 init avals — a
         # dtype drift here would background-compile a program the
@@ -249,6 +412,29 @@ class ServeEngine:
                         i32(), i32(), i32()))
             pre.submit("kv_copy", self._kv_copy,
                        (kv_aval, kv_aval, i32(), i32(), i32()))
+        if self.spec is not None:
+            dp_avals = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self._draft_params)
+            dkv_aval = jax.ShapeDtypeStruct(self.draft_kv_spec.shape,
+                                            kv_dtype)
+            for b, jitted in self._draft_prefills.items():
+                pre.submit(f"draft_prefill_{b}", jitted,
+                           (dp_avals, dkv_aval, dkv_aval,
+                            i32(1, b), i32(), i32()))
+            pre.submit("draft", self._draft,
+                       (dp_avals, dkv_aval, dkv_aval,
+                        i32(self.slots), i32(self.slots)))
+            pre.submit("verify", self._verify,
+                       (abstract_params, kv_aval, kv_aval,
+                        i32(self.slots, self.spec.k + 1),
+                        i32(self.slots, self.spec.k + 1)))
+        if self.kvship:
+            nl, _, _, nh, hd = self.kv_spec.shape
+            for b, jitted in self._kv_imports.items():
+                rows = jax.ShapeDtypeStruct((nl, 1, b, nh, hd), kv_dtype)
+                pre.submit(f"kv_import_{b}", jitted,
+                           (kv_aval, kv_aval, rows, rows, i32()))
         pre.barrier()
 
         # scratch warmup: the warmed cache state is garbage, so re-init
@@ -266,9 +452,28 @@ class ServeEngine:
                                  np.int32(self.slots - 1), np.int32(1))
             k, v, toks = self._suffix(self.params, k, v, np.int32(0),
                                       np.int32(0), np.int32(0))
+        if self.spec is not None:
+            dk, dv = self._dkv_init()
+            for b, jitted in self._draft_prefills.items():
+                dk, dv, _ = jitted(self._draft_params, dk, dv,
+                                   np.zeros((1, b), np.int32),
+                                   np.int32(0), np.int32(1))
+            dk, dv, _ = self._draft(self._draft_params, dk, dv, zeros,
+                                    zeros)
+            z2 = np.zeros((self.slots, self.spec.k + 1), np.int32)
+            k, v, toks = self._verify(self.params, k, v, z2, z2)
+            del dk, dv
+        if self.kvship:
+            nl, _, _, nh, hd = self.kv_spec.shape
+            for b, jitted in self._kv_imports.items():
+                rows = np.zeros((nl, 1, b, nh, hd), kv_dtype)
+                k, v = jitted(k, v, rows, rows, np.int32(0))
         jax.block_until_ready(toks)
         del k, v
         self._k, self._v = self._kv_init()
+        if self.spec is not None:
+            # draft-cache warmup state is garbage too: re-init
+            self._dk, self._dv = self._dkv_init()
         #: trace counts at the end of warmup — any later growth is a
         #: REAL decode-loop retrace (the acceptance counter)
         self.trace_counts_at_warmup = dict(self.trace_counts)
@@ -345,6 +550,91 @@ class ServeEngine:
                      time.monotonic() - t0)
         return toks
 
+    # -- speculative decoding ----------------------------------------------
+
+    def draft_prefill(self, slot: int, tokens: np.ndarray, length: int,
+                      bucket: int) -> None:
+        """Write the DRAFT model's K/V rows for an admitted prompt.
+
+        Runs at every admission (fresh AND prefix-reused) so the draft
+        cache carries the request's history before its first spec
+        round; the emitted-token contract is the target's alone, so
+        the draft prefill's argmax is discarded."""
+        t0 = time.monotonic()
+        self._dk, self._dv, _ = self._draft_prefills[bucket](
+            self._draft_params, self._dk, self._dv,
+            np.asarray(tokens, np.int32), np.int32(slot),
+            np.int32(length))
+        self._charge("rlt_serve_draft_seconds_total",
+                     time.monotonic() - t0)
+
+    def draft(self, tokens: np.ndarray,
+              positions: np.ndarray) -> np.ndarray:
+        """One k-step draft round over every slot: ``[S, k]`` drafted
+        tokens (core/steps.py ``build_draft_step``)."""
+        t0 = time.monotonic()
+        self._dk, self._dv, out = self._draft(
+            self._draft_params, self._dk, self._dv,
+            np.asarray(tokens, np.int32), np.asarray(positions, np.int32))
+        import jax
+        drafts = np.asarray(jax.device_get(out))
+        self._charge("rlt_serve_draft_seconds_total",
+                     time.monotonic() - t0)
+        return drafts
+
+    def verify(self, tokens: np.ndarray, positions: np.ndarray,
+               drafts: np.ndarray) -> np.ndarray:
+        """ONE batched target forward over the k drafted positions:
+        ``[S, k+1]`` target argmaxes — column j is the token plain
+        decode would emit after accepting drafts ``1..j`` (the
+        scheduler folds the longest agreeing prefix + one corrected
+        token).  Counts as a single target forward however many tokens
+        it ends up emitting — the tokens-per-target-forward win."""
+        t0 = time.monotonic()
+        toks2 = np.concatenate(
+            [np.asarray(tokens, np.int32)[:, None],
+             np.asarray(drafts, np.int32)], axis=1)
+        pos2 = (np.asarray(positions, np.int32)[:, None]
+                + np.arange(self.spec.k + 1, dtype=np.int32)[None, :])
+        self._k, self._v, out = self._verify(
+            self.params, self._k, self._v, toks2, pos2)
+        import jax
+        ver = np.asarray(jax.device_get(out))
+        self._charge("rlt_serve_verify_seconds_total",
+                     time.monotonic() - t0)
+        return ver
+
+    # -- KV-page shipping (fleet disaggregation) ---------------------------
+
+    def export_kv(self, slot: int, bucket: int
+                  ) -> "tuple[np.ndarray, np.ndarray]":
+        """Device→host copy of ``slot``'s cache rows ``[0, bucket)``
+        across every layer: ``([n_layer, 1, bucket, H, D], same)`` —
+        the payload a prefill replica ships to a decode replica.  Rows
+        past the prompt are pad garbage; the importer only registers
+        (and the reuse path only copies) the prompt's whole pages, so
+        they never influence decode."""
+        k_rows = np.asarray(self._k[:, slot:slot + 1, :bucket])
+        v_rows = np.asarray(self._v[:, slot:slot + 1, :bucket])
+        return k_rows, v_rows
+
+    def import_kv(self, slot: int, k_rows: np.ndarray,
+                  v_rows: np.ndarray) -> None:
+        """Install shipped donor rows at ``slot`` via the per-bucket
+        AOT ``kv_import_{b}`` program.  Sound for the same reason
+        kv_copy is: a cache row is a pure per-(token, position) value,
+        identical wherever it was computed — including on another
+        replica."""
+        if not self._kv_imports:
+            raise RuntimeError("engine built without kvship=; no "
+                               "import programs")
+        bucket = int(k_rows.shape[2])
+        dt = self._k.dtype  # codec decode yields fp32; the program's
+        # aval is the cache dtype — cast host-side, never retrace
+        self._k, self._v = self._kv_imports[bucket](
+            self._k, self._v, np.asarray(k_rows).astype(dt),
+            np.asarray(v_rows).astype(dt), np.int32(slot))
+
     @staticmethod
     def _charge(name: str, seconds: float) -> None:
         reg = _metrics.get_registry()
@@ -359,7 +649,7 @@ class ServeEngine:
         from ray_lightning_tpu.compile import cache as compile_cache
         s = compile_cache.stats()
         warm = getattr(self, "trace_counts_at_warmup", {})
-        return {
+        out = {
             "decode_kernel": self.decode_kernel,
             "traces": dict(self.trace_counts),
             # traces since the warmup snapshot: 0 everywhere = the
@@ -367,8 +657,14 @@ class ServeEngine:
             "retraces": {name: n - warm.get(name, 0)
                          for name, n in self.trace_counts.items()},
             # kv_init + decode + prefills (+ paged copy/suffix pair)
+            # (+ spec: draft_kv_init + draft prefills + draft + verify)
+            # (+ kvship: one import per bucket) — the program-count
+            # invariant serve/selfcheck.py pins
             "programs": 1 + 1 + len(self._prefills)
-            + (2 if self.paged is not None else 0),
+            + (2 if self.paged is not None else 0)
+            + (3 + len(self._draft_prefills) if self.spec is not None
+               else 0)
+            + len(self._kv_imports),
             "compile_cache": {
                 "active": compile_cache.active_dir() is not None,
                 "hits": s.hits,
@@ -376,6 +672,21 @@ class ServeEngine:
                 "backend_compile_secs": round(s.backend_compile_secs, 3),
             },
         }
+        if self.spec is not None:
+            out["spec"] = {
+                "k": self.spec.k,
+                "draft_layers": self.draft_layers,
+                "draft_quant": self.spec.draft_quant,
+                # what a standalone bf16 draft copy would cost vs the
+                # HBM the residency actually adds (0 = weight-sharing
+                # views; int8 = payload + scales) — the satellite's
+                # reported HBM delta
+                "draft_fp_bytes": self.draft_fp_bytes,
+                "draft_resident_bytes": self.draft_resident_bytes,
+                "draft_hbm_delta_bytes": self.draft_resident_bytes
+                - self.draft_fp_bytes,
+            }
+        return out
 
 
 __all__ = ["ServeEngine"]

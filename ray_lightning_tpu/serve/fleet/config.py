@@ -39,6 +39,18 @@ class FleetConfig:
         replica wins routing while its active-slot load is within this
         many slots of the least-loaded replica (KV affinity keeps
         prefix-reuse hits local without defeating load balance).
+    roles: per-replica pool assignment for disaggregated serving —
+        one of ``"prefill"`` / ``"decode"`` / ``"pooled"`` per replica
+        index, cycled when the fleet outgrows the tuple.  Empty =
+        every replica pooled (the pre-disaggregation behavior).  With
+        both a prefill and a decode pool routable, an admission
+        prefills on the prefill pool, its KV pages ship over the peer
+        channel (``kvship_codec``), and the decode replica finishes
+        the request; either pool emptying fails back to pooled
+        routing.
+    kvship_codec: wire codec for shipped KV pages (comm/quant.py):
+        ``"fp8"`` (default), ``"int8"``, ``"int4"``, ``"bf16"``, or
+        ``"raw"`` (the uncompressed fp32 A/B control leg).
     """
 
     min_replicas: int = 1
@@ -50,6 +62,8 @@ class FleetConfig:
     cooldown_s: float = 10.0
     tick_interval_s: float = 0.5
     sticky_slack: int = 1
+    roles: "tuple[str, ...]" = ()
+    kvship_codec: str = "fp8"
 
     def __post_init__(self):
         if self.min_replicas < 1:
@@ -68,6 +82,16 @@ class FleetConfig:
             raise ValueError("fleet tick_interval_s must be > 0")
         if self.sticky_slack < 0:
             raise ValueError("fleet sticky_slack must be >= 0")
+        object.__setattr__(self, "roles", tuple(self.roles))
+        for r in self.roles:
+            if r not in ("prefill", "decode", "pooled"):
+                raise ValueError(
+                    f"fleet role {r!r}: must be prefill/decode/pooled")
+        from ray_lightning_tpu.comm.quant import CODEC_MODES
+        if self.kvship_codec not in CODEC_MODES + ("raw",):
+            raise ValueError(
+                f"kvship_codec {self.kvship_codec!r}: must be one of "
+                f"{CODEC_MODES + ('raw',)}")
 
     # -- construction ----------------------------------------------------
 
@@ -98,6 +122,12 @@ class FleetConfig:
                 os.environ.get("RLT_FLEET_TICK", "0.5") or 0.5),
             sticky_slack=int(
                 os.environ.get("RLT_FLEET_STICKY_SLACK", "1") or 1),
+            roles=tuple(
+                r.strip()
+                for r in os.environ.get("RLT_FLEET_ROLES", "").split(",")
+                if r.strip()),
+            kvship_codec=os.environ.get(
+                "RLT_KVSHIP_CODEC", "fp8").strip() or "fp8",
         )
 
     # -- env round-trip --------------------------------------------------
@@ -117,7 +147,19 @@ class FleetConfig:
         }
         if self.grow_ttft_p99_ms is not None:
             env["RLT_FLEET_GROW_TTFT_MS"] = repr(self.grow_ttft_p99_ms)
+        if self.roles:
+            env["RLT_FLEET_ROLES"] = ",".join(self.roles)
+        if self.kvship_codec != "fp8":
+            env["RLT_KVSHIP_CODEC"] = self.kvship_codec
         return env
+
+    def role_for(self, index: int) -> str:
+        """Pool assignment for replica ``index``: the roles tuple,
+        cycled so a fleet that outgrows it keeps a deterministic
+        assignment; empty tuple = everything pooled."""
+        if not self.roles:
+            return "pooled"
+        return self.roles[index % len(self.roles)]
 
 
 __all__ = ["FleetConfig"]

@@ -293,6 +293,12 @@ class PagedKV:
         #: slots retained as donors after their request finished,
         #: in retention order (front = least recently useful)
         self._donors: dict[int, int] = {}
+        #: donors a KV-ship is about to export: admission pressure must
+        #: not evict them (the export would skip — or worse, fetch rows
+        #: a re-admitted slot already overwrote).  COUNTED, not a set:
+        #: a finish-time hold and a concurrent export of a prefix-
+        #: sharing prompt may pin the same slot independently
+        self._pinned: dict[int, int] = {}
         self._lru = itertools.count()
         self.tokens_requested = 0
         self.tokens_computed = 0
@@ -342,6 +348,21 @@ class PagedKV:
         self._donors[slot] = next(self._lru)
         return True
 
+    def pin(self, slot: int) -> None:
+        """Shield a donor from LRU eviction while a KV-ship leg holds
+        it (pinned until the export fetches its rows, or the router
+        releases the hold on a failed leg)."""
+        if slot in self._donors:
+            self._pinned[slot] = self._pinned.get(slot, 0) + 1
+
+    def unpin(self, slot: int) -> None:
+        n = self._pinned.get(slot)
+        if n is not None:
+            if n <= 1:
+                self._pinned.pop(slot)
+            else:
+                self._pinned[slot] = n - 1
+
     def evict_lru_donor(self, exclude: Optional[int] = None
                         ) -> "int | None":
         """Free the least-recently-useful donor's slot (admission
@@ -349,8 +370,11 @@ class PagedKV:
         ``exclude`` protects the donor the admission is ABOUT to copy
         from (scheduler plan order: match, then evict) — evicting the
         one donor you need defeats the cache exactly under the slot
-        pressure that makes it valuable."""
-        candidates = [s for s in self._donors if s != exclude]
+        pressure that makes it valuable.  Pinned donors (a KV-ship in
+        flight) never evict: admission waits for the ship to release
+        them instead of starving the export."""
+        candidates = [s for s in self._donors
+                      if s != exclude and s not in self._pinned]
         if not candidates:
             return None
         slot = min(candidates, key=self._donors.get)
@@ -364,6 +388,7 @@ class PagedKV:
         for slot in list(self.index.registered()):
             self.index.drop(slot)
         self._donors.clear()
+        self._pinned.clear()
         self.pool._held.clear()
 
     @property
@@ -379,6 +404,7 @@ class PagedKV:
             "pages_free": self.pool.free,
             "pages_allocated": self.pool.allocated,
             "donors": self.donor_count,
+            "pinned_donors": len(self._pinned),
             "prefix_hits": self.index.hits,
             "prefix_misses": self.index.misses,
             "reused_prefills": self.reused_prefills,

@@ -32,9 +32,14 @@ from typing import Optional
 class FleetReplica:
     """Router-side handle on one serve replica."""
 
-    def __init__(self, rid: int, server):
+    def __init__(self, rid: int, server, role: str = "pooled"):
         self.id = int(rid)
         self.server = server
+        #: disaggregation pool (config.py roles): "prefill" replicas
+        #: take admissions, "decode" replicas finish shipped requests,
+        #: "pooled" does both (the router fails back to pooled routing
+        #: when a dedicated pool empties)
+        self.role = str(role)
         self.state = "starting"
         self.created_at = time.time()
         self.started_at: Optional[float] = None
@@ -105,12 +110,14 @@ class FleetReplica:
         """The routing-policy view of this replica
         (serve/fleet/router.py pick_replica)."""
         return {"rid": self.id, "active": self.active,
-                "queued": self.queued, "slots": self.slots}
+                "queued": self.queued, "slots": self.slots,
+                "role": self.role}
 
     def status(self) -> dict:
         sched = self.server.scheduler
         doc = {
             "state": self.state,
+            "role": self.role,
             "active": sched.active_count,
             "queued": sched.queued_count,
             "slots": sched.allocator.slots,

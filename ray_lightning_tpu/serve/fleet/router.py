@@ -66,18 +66,42 @@ _log = logging.getLogger(__name__)
 
 
 def pick_replica(rows: "list[dict]", sticky_rid: Optional[int] = None,
-                 sticky_slack: int = 1) -> Optional[int]:
+                 sticky_slack: int = 1,
+                 pool: Optional[str] = None,
+                 spill: bool = False) -> Optional[int]:
     """Routing policy (pure — fleet/selfcheck.py drives it directly).
 
-    ``rows``: one ``{"rid", "active", "queued", "slots"}`` per routable
-    replica.  Least-loaded wins: fewest active slots, then shortest
-    queue, then lowest id (deterministic).  The tenant's sticky replica
-    overrides the winner only while its load is within ``sticky_slack``
-    of the winner on BOTH axes — KV affinity must never hide a hot
-    replica.
+    ``rows``: one ``{"rid", "active", "queued", "slots"[, "role"]}``
+    per routable replica.  Least-loaded wins: fewest active slots, then
+    shortest queue, then lowest id (deterministic).  The tenant's
+    sticky replica overrides the winner only while its load is within
+    ``sticky_slack`` of the winner on BOTH axes — KV affinity must
+    never hide a hot replica.
+
+    ``pool`` restricts routing to one disaggregation role ("prefill" /
+    "decode"); when NO row carries that role the filter falls back to
+    every row — a role pool that emptied (shrink, failover) degrades
+    to pooled routing instead of stranding requests.
+
+    ``spill`` (pooled decode-pool traffic only): when the pool's best
+    replica is saturated (every slot live AND a queue behind it), a
+    fully-idle replica OUTSIDE the pool joins the candidates — after a
+    prefill burst drains, the dedicated prefill replica absorbs pooled
+    work instead of idling while the decode pool grinds its backlog.
     """
     if not rows:
         return None
+    if pool is not None:
+        pooled = [r for r in rows if r.get("role", "pooled") == pool]
+        if pooled:
+            if spill:
+                best = min(pooled, key=lambda r: (
+                    r["active"], r["queued"], r["rid"]))
+                if best["active"] >= best["slots"] and best["queued"]:
+                    pooled = pooled + [
+                        r for r in rows if r not in pooled
+                        and r["active"] == 0 and r["queued"] == 0]
+            rows = pooled
     best = min(rows, key=lambda r: (r["active"], r["queued"], r["rid"]))
     if sticky_rid is not None and sticky_rid != best["rid"]:
         for r in rows:
@@ -123,6 +147,11 @@ class FleetRequest:
         self.tpot_s: Optional[float] = None
         self.error: Optional[BaseException] = None
         self._tokens: Optional[np.ndarray] = None
+        #: disaggregation state (router-owned): ``{"stage": "prefill"}``
+        #: while the prefill leg runs, then ``{"stage": "decode",
+        #: "head": [t1], "shipped": bool}`` on the decode leg; the head
+        #: tokens prepend to the decode leg's stream at completion
+        self._disagg: Optional[dict] = None
         self._event = threading.Event()
 
     def done(self) -> bool:
@@ -215,6 +244,21 @@ class FleetServer:
         self.completed = 0
         self.failed = 0
         self.requeued = 0
+        #: KV-ship channel (disaggregated decode): the router is both
+        #: ends' driver, so one Mailbox IS the peer channel — puts can
+        #: be chaos-dropped (arm_kvship_drop) and takes retry/backoff
+        #: per RLT_PEER_RETRIES exactly like the worker↔worker plane
+        from ray_lightning_tpu.cluster.peer import Mailbox
+        self._kvship_mailbox = Mailbox()
+        self._kvship_drop = 0
+        self._kvship_seconds = 0.0
+        #: ships run OFF the router pump (a ship is two worker RPCs
+        #: plus the codec; inline it would stall every other request's
+        #: dispatch — the exact TTFT the disaggregation exists to win)
+        self._kvship_pool = None
+        self.kvship = {"codec": cfg.kvship_codec, "ships": 0,
+                       "bytes_wire": 0, "bytes_raw": 0, "retries": 0,
+                       "failovers": 0, "skipped": 0}
 
     # -- construction ------------------------------------------------------
 
@@ -229,6 +273,14 @@ class FleetServer:
         kw = dict(self._server_kwargs)
         worker_env = {**self.cfg.worker_env(),
                       **kw.pop("worker_env", {})}
+        if self.cfg.role_for(rid) == "prefill" \
+                and "max_prefills_per_step" not in kw:
+            # a dedicated prefill replica never interleaves decode
+            # tails, so it batches admissions to its slot count — the
+            # admission-throughput half of the disaggregation win (a
+            # pooled replica admitting this greedily would stall its
+            # live decodes' TPOT every step)
+            kw["max_prefills_per_step"] = kw.get("max_batch_slots", 8)
         # replicas carry their own aggregator (heartbeats + flight
         # recorder for THEIR workers) but never the driver metrics
         # registry or HTTP endpoint — those are fleet-level singletons
@@ -241,6 +293,10 @@ class FleetServer:
             tenant_quotas=None,
             telemetry=rep_telemetry,
             paged=self.paged,
+            # roles configured → every replica can ship/receive KV
+            # pages (the per-bucket import programs are cheap and a
+            # failback-to-pooled replica may still receive a ship)
+            kvship=bool(self.cfg.roles) and self.paged.enabled,
             default_root_dir=os.path.join(self.default_root_dir,
                                           f"replica_{rid}"),
             worker_env=worker_env,
@@ -250,7 +306,8 @@ class FleetServer:
         with self._lock:
             rid = self._rid
             self._rid += 1
-            rep = FleetReplica(rid, self._factory(rid))
+            rep = FleetReplica(rid, self._factory(rid),
+                               role=self.cfg.role_for(rid))
             self._replicas[rid] = rep
         return rep
 
@@ -389,7 +446,18 @@ class FleetServer:
             if inner is None or not inner.done():
                 continue
             if inner.error is None:
-                self._finish_ok(fr)
+                stage = (fr._disagg.get("stage")
+                         if fr._disagg is not None else None)
+                if stage == "prefill":
+                    # hand the ship to the kvship pool; the pump keeps
+                    # dispatching while the pages travel
+                    fr._disagg["stage"] = "shipping"
+                    self._kvship_executor().submit(
+                        self._advance_disagg_task, fr)
+                elif stage == "shipping":
+                    continue     # leg 1 done, ship in flight
+                else:
+                    self._finish_ok(fr)
             else:
                 rep = self._replicas.get(fr.replica)
                 if rep is not None and rep.failed:
@@ -398,9 +466,14 @@ class FleetServer:
 
     def _finish_ok(self, fr: FleetRequest) -> None:
         inner = fr.inner
-        fr._tokens = np.asarray(inner.generated, dtype=np.int32)
+        toks = list(inner.generated)
+        if fr._disagg is not None:
+            # the prefill leg's token(s) lead the decode leg's stream
+            toks = list(fr._disagg.get("head", ())) + toks
+        fr._tokens = np.asarray(toks, dtype=np.int32)
         fr.t_done = time.monotonic()
-        if inner.t_first is not None:
+        if fr.ttft_s is None and inner.t_first is not None:
+            # disaggregated requests stamped TTFT at the prefill leg
             fr.ttft_s = inner.t_first - fr.t_submit
             self._ttfts.append(fr.ttft_s)
         fr.tpot_s = inner.tpot_s
@@ -433,6 +506,7 @@ class FleetServer:
                 0, self._tenant_inflight.get(fr.tenant, 1) - 1)
             fr.inner = None
             fr.replica = None
+            fr._disagg = None    # a redispatch restarts from scratch
             fr.requeues += 1
             self._pending.appendleft(fr)
             self.requeued += 1
@@ -539,16 +613,49 @@ class FleetServer:
                 if quota is not None and \
                         self._tenant_inflight.get(fr.tenant, 0) >= quota:
                     continue   # tenant at fleet-wide quota; others pass
-                rid = pick_replica(list(rows.values()),
-                                   self._sticky.get(fr.tenant),
-                                   self.cfg.sticky_slack)
+                disagg = self._disagg_eligible(fr, reps)
+                if disagg:
+                    # disaggregated: the prefill pool computes the
+                    # prompt (ONE token), its KV pages ship, a decode
+                    # replica finishes the request (_advance_disagg)
+                    rid = pick_replica(list(rows.values()),
+                                       None, 0, pool="prefill")
+                else:
+                    # with roles configured, pooled traffic routes to
+                    # the DECODE pool: a full request parked on a
+                    # prefill replica would hold one of its slots for
+                    # a whole decode tail, stalling every disagg
+                    # admission behind it (pick_replica fails back to
+                    # all rows when the pool empties)
+                    rid = pick_replica(list(rows.values()),
+                                       self._sticky.get(fr.tenant),
+                                       self.cfg.sticky_slack,
+                                       pool="decode" if self.cfg.roles
+                                       else None,
+                                       spill=bool(self.cfg.roles))
                 if rid is None:
                     break
                 rep = reps[rid]
                 try:
-                    inner = rep.server.submit(
-                        fr.prompt, tenant=fr.tenant,
-                        max_new_tokens=fr.max_new_tokens)
+                    if disagg:
+                        # piggyback the KV export only when the decode
+                        # pool could actually adopt it right now — a
+                        # doomed export still costs the prefill lane a
+                        # device fetch per admission (ship_kv=False
+                        # legs fall back to the donor-match export,
+                        # opportunistically)
+                        ship = any(
+                            r.role == "decode"
+                            and hasattr(r.server, "can_adopt_kv")
+                            and r.server.can_adopt_kv()
+                            for r in reps.values())
+                        inner = rep.server.submit(
+                            fr.prompt, tenant=fr.tenant,
+                            max_new_tokens=1, ship_kv=ship)
+                    else:
+                        inner = rep.server.submit(
+                            fr.prompt, tenant=fr.tenant,
+                            max_new_tokens=fr.max_new_tokens)
                 except Exception:
                     # replica refused (failed/draining between probe
                     # and submit); the failure scan sorts it out
@@ -560,11 +667,229 @@ class FleetServer:
                 self._pending.remove(fr)
                 fr.inner = inner
                 fr.replica = rid
+                fr._disagg = {"stage": "prefill"} if disagg else None
                 self._inflight[fr.id] = fr
                 self._tenant_inflight[fr.tenant] = \
                     self._tenant_inflight.get(fr.tenant, 0) + 1
-                self._sticky[fr.tenant] = rid
+                if not disagg:
+                    self._sticky[fr.tenant] = rid
                 rows[rid]["queued"] += 1   # count our own dispatches
+
+    # -- disaggregated decode (prefill pool → KV ship → decode pool) -------
+
+    def _disagg_eligible(self, fr: FleetRequest,
+                         reps: "dict[int, FleetReplica]") -> bool:
+        """Disaggregate this request?  Needs BOTH dedicated pools
+        routable (a pool that emptied fails back to pooled routing),
+        shippable replicas (paging + kv_import programs on both ends),
+        a prompt long enough to own at least one whole page, room in
+        the buckets for the decode leg's prompt+first-token resubmit,
+        and more than one token wanted (a 1-token request IS its
+        prefill leg)."""
+        prefills = [r for r in reps.values() if r.role == "prefill"]
+        decodes = [r for r in reps.values() if r.role == "decode"]
+        if not prefills or not decodes:
+            return False
+        if not all(r.server.can_ship_kv() for r in prefills + decodes):
+            return False
+        if fr.max_new_tokens is not None and fr.max_new_tokens <= 1:
+            return False
+        buckets = prefills[0].server.buckets
+        if len(fr.prompt) + 1 > max(buckets):
+            return False
+        return len(fr.prompt) >= self.paged.page_size
+
+    def _kvship_executor(self):
+        with self._lock:
+            if self._kvship_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._kvship_pool = ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix="rlt-kvship")
+            return self._kvship_pool
+
+    def _advance_disagg_task(self, fr: FleetRequest) -> None:
+        """Pool-thread wrapper: a ship/advance that dies for any reason
+        requeues the request (a redispatch restarts it from scratch,
+        pooled if the pools vanished meanwhile)."""
+        try:
+            self._advance_disagg(fr)
+        except Exception:
+            _log.error("disagg advance failed; requeueing fleet "
+                       "request %d", fr.id, exc_info=True)
+            self._requeue(fr)
+        finally:
+            self._wake.set()
+
+    def _advance_disagg(self, fr: FleetRequest) -> None:
+        """The prefill leg finished (one token): ship its KV pages to
+        a decode replica and submit the decode leg there.  A ship that
+        times out (chaos drop, dead peer) fails over PER-REQUEST: the
+        decode replica simply prefills the prompt itself (pooled mode)
+        — deterministic greedy makes the answer identical, only the
+        prefill compute is paid twice."""
+        leg1 = fr.inner
+        t1 = int(leg1.generated[-1])
+        if fr.ttft_s is None and leg1.t_first is not None:
+            # fleet TTFT = the PREFILL leg's first token — the number
+            # the disaggregation bench compares against pooled serving
+            fr.ttft_s = leg1.t_first - fr.t_submit
+            self._ttfts.append(fr.ttft_s)
+        want = fr.max_new_tokens
+        if want is None:
+            # pin the effective budget so leg-2 doesn't re-apply the
+            # full per-replica default on top of the prefill token
+            src0 = self._replicas.get(fr.replica)
+            want = src0.server.scheduler.default_max_new_tokens \
+                if src0 is not None else 32
+        hit_eos = (leg1.eos_token is not None
+                   and t1 == leg1.eos_token)
+        if hit_eos or (want is not None and want <= 1):
+            fr._disagg = {"stage": "done", "head": []}
+            self._finish_ok(fr)
+            return
+        with self._lock:
+            routable = {rep.id: rep for rep in self._routable()}
+        rows = [rep.load_row() for rep in routable.values()]
+        rid = pick_replica(rows, None, 0, pool="decode")
+        rep = routable.get(rid) if rid is not None else None
+        if rep is None:
+            self._requeue(fr)       # decode pool AND failback empty
+            return
+        src = self._replicas.get(fr.replica)
+        shipped = False
+        if src is not None:
+            shipped = self._ship_kv(src, rep, fr)
+        prompt2 = np.concatenate(
+            [fr.prompt, np.asarray([t1], dtype=np.int32)])
+        remaining = None if want is None else want - 1
+        try:
+            inner2 = rep.server.submit(prompt2, tenant=fr.tenant,
+                                       max_new_tokens=remaining)
+        except Exception:
+            self._requeue(fr)
+            return
+        with self._lock:
+            fr.inner = inner2
+            fr.replica = rep.id
+            fr._disagg = {"stage": "decode", "head": [t1],
+                          "shipped": shipped}
+
+    def _ship_kv(self, src: FleetReplica, dst: FleetReplica,
+                 fr: FleetRequest) -> bool:
+        """One KV-page ship over the peer channel: export the donor
+        rows from the prefill replica, codec-compress them onto the
+        mailbox, take with retry/backoff (RLT_PEER_RETRIES), decode
+        and install on the decode replica.  False = the decode leg
+        must prefill for itself (per-request pooled failover)."""
+        from ray_lightning_tpu.cluster.peer import PeerTimeout, \
+            _retry_policy
+        from ray_lightning_tpu.comm.quant import dequantize_blob, \
+            quantize_blob
+        t0 = time.monotonic()
+        codec = self.cfg.kvship_codec
+        prompt, fid = fr.prompt, fr.id
+        try:
+            # the leg-1 request's prefill piggybacked its rows into
+            # the prefill replica's kv outbox (claimed by req_id) —
+            # no worker round-trip, no donor-eviction race
+            exported = src.server.export_kv(
+                prompt, req_id=getattr(fr.inner, "id", None))
+            if exported is None:
+                self.kvship["skipped"] += 1
+                return False
+            if hasattr(dst.server, "can_adopt_kv") \
+                    and not dst.server.can_adopt_kv():
+                # every destination slot is live: the install would
+                # fail after paying quantize + mailbox + a worker
+                # round-trip — skip up front and let the decode leg
+                # prefill for itself (same fallback, none of the cost)
+                self.kvship["skipped"] += 1
+                return False
+            k_rows, v_rows, matched = exported
+            kp, ks = quantize_blob(k_rows, codec)
+            vp, vs = quantize_blob(v_rows, codec)
+            payload = {
+                "k": (np.asarray(kp), None if ks is None
+                      else np.asarray(ks)),
+                "v": (np.asarray(vp), None if vs is None
+                      else np.asarray(vs)),
+                "shape": tuple(k_rows.shape), "codec": codec,
+                "tokens": np.asarray(prompt[:matched], dtype=np.int32),
+            }
+            wire = sum(a.nbytes for pair in (payload["k"], payload["v"])
+                       for a in pair if a is not None)
+            raw = 2 * int(np.prod(k_rows.shape)) * 4   # fp32 baseline
+            tag = ("kvship", int(fid))
+            with self._lock:
+                drop = self._kvship_drop > 0
+                if drop:
+                    self._kvship_drop -= 1
+            if not drop:
+                self._kvship_mailbox.put(tag, payload)
+            else:
+                _log.warning("kvship chaos: dropping ship for fleet "
+                             "request %d", fid)
+            try:
+                got = self._kvship_mailbox.take(
+                    tag, timeout=self._kvship_timeout(),
+                    who=f"decode replica {dst.id}",
+                    src=f"prefill replica {src.id}")
+            except PeerTimeout as e:
+                retries, _ = _retry_policy()
+                self.kvship["retries"] += retries
+                self.kvship["failovers"] += 1
+                self._count("rlt_kvship_retries_total", max(1, retries))
+                self._count("rlt_kvship_failovers_total", 1)
+                if self._agg is not None:
+                    # correlation event: the flight-dump / incident
+                    # timeline names the failover cause next to the
+                    # latency it explains
+                    self._agg.note_event(
+                        "kvship_failover", request=int(fid),
+                        src=src.id, dst=dst.id, cause=repr(e))
+                _log.warning("kvship failover for fleet request %d: %s",
+                             fid, e)
+                return False
+            k2 = dequantize_blob(got["k"][0], got["k"][1],
+                                 got["codec"], got["shape"])
+            v2 = dequantize_blob(got["v"][0], got["v"][1],
+                                 got["codec"], got["shape"])
+            if not dst.server.import_kv(got["tokens"],
+                                        np.asarray(k2),
+                                        np.asarray(v2)):
+                self.kvship["skipped"] += 1
+                return False
+            self.kvship["ships"] += 1
+            self.kvship["bytes_wire"] += wire
+            self.kvship["bytes_raw"] += raw
+            self._count("rlt_kvship_ships_total", 1, codec=codec)
+            self._count("rlt_kvship_bytes_total", wire, codec=codec)
+            return True
+        except Exception:
+            _log.warning("kvship failed; decode leg prefills locally",
+                         exc_info=True)
+            self.kvship["failovers"] += 1
+            self._count("rlt_kvship_failovers_total", 1)
+            return False
+        finally:
+            with self._lock:
+                self._kvship_seconds += time.monotonic() - t0
+
+    @staticmethod
+    def _kvship_timeout() -> float:
+        try:
+            return float(os.environ.get("RLT_KVSHIP_TIMEOUT_S", "0.2")
+                         or 0.2)
+        except ValueError:
+            return 0.2
+
+    def arm_kvship_drop(self, count: int = 1) -> None:
+        """Chaos hook (the serve analog of the elastic plane's
+        ``peerdrop`` fault): drop the next ``count`` KV-page ships on
+        the channel, forcing the retry → timeout → per-request
+        pooled-failover path the chaos test pins."""
+        with self._lock:
+            self._kvship_drop += int(count)
 
     # -- autoscaling -------------------------------------------------------
 
@@ -734,6 +1059,8 @@ class FleetServer:
         self._wake.set()
         if self._pump is not None and self._pump.is_alive():
             self._pump.join(10)
+        if self._kvship_pool is not None:
+            self._kvship_pool.shutdown(wait=False, cancel_futures=True)
         for t in self._scale_threads:
             t.join(30)
         reps = list(self._replicas.values())
@@ -788,6 +1115,16 @@ class FleetServer:
                            "max": self.cfg.max_replicas},
             }
         }
+        if self.cfg.roles:
+            # disaggregated-decode evidence: wire bytes by codec, the
+            # compression ratio vs the fp32 baseline, and the chaos
+            # counters (retries / per-request failovers)
+            kv = dict(self.kvship)
+            kv["roles"] = list(self.cfg.roles)
+            kv["compression_ratio"] = round(
+                kv["bytes_raw"] / kv["bytes_wire"], 4) \
+                if kv["bytes_wire"] else None
+            doc["fleet"]["kvship"] = kv
         if pages:
             doc["fleet"]["pages"] = pages
         gp = self.goodput_stats()
@@ -818,8 +1155,12 @@ class FleetServer:
             return None
         actuation = sum(float(e.get("seconds") or 0.0)
                         for e in self.autoscaler.stats().get("events", ()))
-        return _goodput.aggregate(
-            docs, extra_buckets={"autoscale": actuation})
+        extra = {"autoscale": actuation}
+        if self._kvship_seconds:
+            # KV shipping runs on the router thread between the two
+            # legs — it's wall the replicas never see, attributed here
+            extra["kv_ship"] = self._kvship_seconds
+        return _goodput.aggregate(docs, extra_buckets=extra)
 
     def pages_stats(self) -> Optional[dict]:
         """Fleet-aggregated prefix-reuse numbers (sums the replicas'

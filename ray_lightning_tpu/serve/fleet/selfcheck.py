@@ -33,9 +33,11 @@ def _check_config_roundtrip() -> None:
                       grow_queue_depth=3.5, grow_ttft_p99_ms=250.0,
                       shrink_occupancy=0.2, patience_ticks=3,
                       cooldown_s=7.5, tick_interval_s=0.25,
-                      sticky_slack=2)
+                      sticky_slack=2, roles=("prefill", "decode"),
+                      kvship_codec="int8")
     saved = {k: os.environ.pop(k) for k in list(os.environ)
-             if k.startswith(("RLT_FLEET", "RLT_SERVE_PAGE"))}
+             if k.startswith(("RLT_FLEET", "RLT_SERVE_PAGE",
+                              "RLT_KVSHIP"))}
     try:
         os.environ.update(cfg.worker_env())
         assert FleetConfig.resolve(None) == cfg, FleetConfig.resolve(None)
@@ -50,11 +52,18 @@ def _check_config_roundtrip() -> None:
         assert not PageConfig(enabled=False).worker_env()
     finally:
         for k in list(os.environ):
-            if k.startswith(("RLT_FLEET", "RLT_SERVE_PAGE")):
+            if k.startswith(("RLT_FLEET", "RLT_SERVE_PAGE",
+                             "RLT_KVSHIP")):
                 del os.environ[k]
         os.environ.update(saved)
+    # role cycling: a fleet that outgrows the tuple stays deterministic
+    assert [cfg.role_for(i) for i in range(4)] == \
+        ["prefill", "decode", "prefill", "decode"]
+    assert FleetConfig().role_for(3) == "pooled"
     for bad in (dict(min_replicas=0), dict(max_replicas=0),
-                dict(patience_ticks=0), dict(tick_interval_s=0)):
+                dict(patience_ticks=0), dict(tick_interval_s=0),
+                dict(roles=("prefill", "verify")),
+                dict(kvship_codec="zstd")):
         try:
             FleetConfig(**bad)
         except ValueError:
@@ -179,6 +188,60 @@ def _check_router_policy() -> None:
     print("fleet selfcheck: router least-loaded/sticky/quota OK")
 
 
+def _check_pool_routing() -> None:
+    """Disaggregation pools: ``pool=`` restricts routing to one role;
+    an EMPTY pool falls back to every row (a drained/failed role pool
+    degrades to pooled routing instead of stranding requests)."""
+    from ray_lightning_tpu.serve.fleet.router import pick_replica
+
+    rows = [{"rid": 0, "active": 3, "queued": 0, "slots": 4,
+             "role": "prefill"},
+            {"rid": 1, "active": 0, "queued": 0, "slots": 4,
+             "role": "decode"},
+            {"rid": 2, "active": 1, "queued": 0, "slots": 4,
+             "role": "prefill"}]
+    assert pick_replica(rows, pool="prefill") == 2   # busier 0 loses
+    assert pick_replica(rows, pool="decode") == 1
+    # decode pool emptied -> failback to pooled (least-loaded overall)
+    no_decode = [r for r in rows if r["role"] != "decode"]
+    assert pick_replica(no_decode, pool="decode") == 2
+    # rows without a role key count as pooled, never as a named pool
+    bare = [{"rid": 7, "active": 0, "queued": 0, "slots": 4}]
+    assert pick_replica(bare + rows, pool="prefill") == 2
+    assert pick_replica(bare, pool="prefill") == 7   # failback again
+    print("fleet selfcheck: pool routing + empty-pool failback OK")
+
+
+def _check_kvship_codecs() -> None:
+    """KV wire bytes by codec: fp8/int8 pages must ride the wire at
+    >= 3x under the raw (fp32) control leg, and every codec must
+    round-trip shape-exact (bit-exact for raw — the ship→resume parity
+    leg tests/test_fleet.py pins end-to-end)."""
+    import numpy as np
+
+    from ray_lightning_tpu.comm.quant import (dequantize_blob,
+                                              quantize_blob)
+    rows = (np.arange(2 * 1 * 64 * 2 * 16, dtype=np.float32)
+            .reshape(2, 1, 64, 2, 16) / 777.0 - 1.1).astype("bfloat16")
+    raw_payload, _ = quantize_blob(rows, "raw")
+    raw_bytes = np.asarray(raw_payload).nbytes
+    assert raw_bytes == rows.size * 4, "raw control leg must be fp32"
+    for codec in ("fp8", "int8"):
+        payload, scales = quantize_blob(rows, codec)
+        wire = np.asarray(payload).nbytes + (
+            np.asarray(scales).nbytes if scales is not None else 0)
+        ratio = raw_bytes / wire
+        assert ratio >= 3.0, (codec, ratio)
+        back = np.asarray(dequantize_blob(payload, scales, codec,
+                                          rows.shape))
+        assert back.shape == rows.shape, (codec, back.shape)
+    back = np.asarray(dequantize_blob(raw_payload, None, "raw",
+                                      rows.shape)).astype("bfloat16")
+    assert (back == rows).all(), "raw roundtrip not bit-exact"
+    print("fleet selfcheck: kvship codec wire-bytes >= 3x + "
+          "roundtrip OK")
+
+
 def _check_metric_names() -> None:
     from ray_lightning_tpu.telemetry.metrics import validate_metric_name
     for name in ("rlt_fleet_replicas_total",
@@ -188,7 +251,10 @@ def _check_metric_names() -> None:
                  "rlt_fleet_grow_total", "rlt_fleet_shrink_total",
                  "rlt_fleet_failover_total",
                  "rlt_fleet_scale_seconds_total",
-                 "rlt_serve_prefill_tokens_total"):
+                 "rlt_serve_prefill_tokens_total",
+                 "rlt_kvship_ships_total", "rlt_kvship_bytes_total",
+                 "rlt_kvship_retries_total",
+                 "rlt_kvship_failovers_total"):
         validate_metric_name(name)
     print("fleet selfcheck: metric names Prometheus-clean")
 
@@ -199,6 +265,8 @@ def _main(argv: list) -> int:
     _check_prefix_index()
     _check_autoscaler()
     _check_router_policy()
+    _check_pool_routing()
+    _check_kvship_codecs()
     _check_metric_names()
     return 0
 

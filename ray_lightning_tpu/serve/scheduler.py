@@ -87,6 +87,13 @@ class ServeRequest:
         #: broadcast to the workers, whose prefill/decode spans carry it
         #: back, so the aggregator reassembles this request's span tree
         self.trace = _tracing.mint_trace_id()
+        #: speculative-decode per-request state (serve/spec.py): the
+        #: rolling window of per-round accepted counts the fallback
+        #: watches, and the ``spec_off`` latch — once acceptance
+        #: collapses below the floor this request takes only the
+        #: verify's first (= plain-decode) token for its remaining life
+        self.spec_off = False
+        self._spec_window = None
         self.t_submit = time.monotonic()
         #: wall-clock twins of the monotonic stamps — the trace plane's
         #: synthetic driver spans must share the workers' wall timeline
@@ -156,6 +163,10 @@ class _Tenant:
     queue: list = field(default_factory=list)
     active: int = 0
     served_tokens: int = 0
+    # per-tenant speculative-decode accounting (acceptance_rate rides
+    # the same per_tenant stats block quotas do)
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
 
 class Scheduler:
@@ -167,7 +178,8 @@ class Scheduler:
                  max_prefills_per_step: int = 1,
                  default_max_new_tokens: int = 32,
                  eos_token: Optional[int] = None,
-                 paged: Any = None):
+                 paged: Any = None,
+                 spec: Any = None):
         self.buckets = tuple(buckets)
         self.max_seq_len = int(max_seq_len)
         self.allocator = SlotAllocator(slots)
@@ -178,6 +190,16 @@ class Scheduler:
         if paged is not None and getattr(paged, "enabled", False):
             from ray_lightning_tpu.serve.fleet.pages import PagedKV
             self.pages = PagedKV(paged, slots, self.max_seq_len)
+        #: speculative decoding (serve/spec.py SpecConfig): when set,
+        #: decode steps are planned as draft→verify rounds and apply()
+        #: folds multi-token results; the emitted stream stays EXACTLY
+        #: greedy-parity (only the target's verify decides tokens)
+        self.spec = spec \
+            if spec is not None and getattr(spec, "enabled", False) \
+            else None
+        self._spec = {"drafted": 0, "accepted": 0, "corrected": 0,
+                      "emitted": 0, "slot_steps": 0, "rounds": 0,
+                      "fallbacks": 0}
         self.max_prefills_per_step = max(1, int(max_prefills_per_step))
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.eos_token = eos_token
@@ -187,6 +209,10 @@ class Scheduler:
             dict(quotas) if isinstance(quotas, dict) else {})
         self._tenants: dict[str, _Tenant] = {}
         self._by_slot: dict[int, ServeRequest] = {}
+        #: ship-bound prefills' exported KV rows, keyed by request id
+        #: (plan ``export_kv`` → apply stash → Server.export_kv pop);
+        #: FIFO-capped so abandoned ships can't hold rows forever
+        self._kv_outbox: dict[int, tuple] = {}
         self._ids = itertools.count()
         self._arrival = itertools.count()
         self._order: dict[int, int] = {}     # req id -> arrival seq
@@ -213,7 +239,8 @@ class Scheduler:
         return t
 
     def submit(self, tokens, tenant: str = "default",
-               max_new_tokens: Optional[int] = None) -> ServeRequest:
+               max_new_tokens: Optional[int] = None,
+               ship_kv: bool = False) -> ServeRequest:
         tokens = np.asarray(tokens, dtype=np.int32).reshape(-1)
         if len(tokens) == 0:
             raise ValueError("empty prompt")
@@ -226,6 +253,13 @@ class Scheduler:
         req = ServeRequest(next(self._ids), tenant, tokens,
                            max(1, min(int(want), cap)), self.eos_token)
         req.bucket = bucket
+        # disagg leg-1: the prefill step piggybacks a KV-row export
+        # (plan's ``export_kv`` entry) into the kv outbox, so the
+        # router's ship never races donor eviction for the rows
+        req._ship_kv = bool(ship_kv)
+        if self.spec is not None:
+            from collections import deque
+            req._spec_window = deque(maxlen=self.spec.window)
         with self._lock:
             self._order[req.id] = next(self._arrival)
             self._tenant(tenant).queue.append(req)
@@ -310,6 +344,11 @@ class Scheduler:
                     # carries it back on the queue channel)
                     "trace": req.trace,
                 }
+                if self.spec is not None:
+                    # prime the draft KV cache alongside the target's
+                    # (worker.py runs engine.draft_prefill after the
+                    # target prefill) so round one can draft immediately
+                    entry["draft"] = True
                 computed = len(req.tokens)
                 if self.pages is not None:
                     if hit is not None and hit[1] >= self.pages.page_size:
@@ -317,6 +356,20 @@ class Scheduler:
                         entry["reuse"] = {"src": int(src),
                                           "matched": int(matched)}
                         computed = max(1, len(req.tokens) - matched)
+                    if getattr(req, "_ship_kv", False):
+                        # ship-bound prefill: the worker returns the
+                        # slot's whole-page KV rows WITH the step
+                        # result (no later export RPC, no donor-
+                        # eviction race) — apply() stashes them in the
+                        # kv outbox for the router's ship leg
+                        pages = (len(req.tokens)
+                                 // self.pages.page_size) \
+                            * self.pages.page_size
+                        if pages >= self.pages.page_size:
+                            entry["export_kv"] = {
+                                "bucket": int(bucket_for(
+                                    pages, self.buckets)),
+                                "matched": int(pages)}
                     self.pages.on_admit(slot, req.tokens, computed)
                     self._count("rlt_serve_prefill_tokens_total",
                                 len(req.tokens), kind="requested")
@@ -360,6 +413,12 @@ class Scheduler:
                       # live request's tree (aggregator._span_trace_ids)
                       "traces": {s: self._by_slot[s].trace
                                  for s in decode_slots}}
+            # speculative round only while at least one live slot still
+            # speculates — when EVERY request has fallen back the plain
+            # decode program runs and the draft cost disappears
+            if self.spec is not None and any(
+                    not self._by_slot[s].spec_off for s in decode_slots):
+                decode["spec"] = True
         if not prefills and decode is None:
             return None
         if decode is not None:
@@ -381,6 +440,16 @@ class Scheduler:
         for p in plan["prefills"]:
             slot = p["slot"]
             req = self._by_slot[slot]
+            exp = p.get("export_kv")
+            if exp is not None:
+                rows = (result.get("kv_export") or {}).get(slot)
+                if rows is not None:
+                    with self._lock:
+                        self._kv_outbox[req.id] = (
+                            rows[0], rows[1], exp["matched"])
+                        while len(self._kv_outbox) > 64:
+                            self._kv_outbox.pop(
+                                next(iter(self._kv_outbox)))
             tok = int(result["prefill"][slot])
             req.t_first = now
             req.generated.append(tok)
@@ -397,7 +466,11 @@ class Scheduler:
                 req = self._by_slot.get(slot)
                 if req is None:      # finished by a racing eviction
                     continue
-                tok = int(result["decode"][slot])
+                res = result["decode"][slot]
+                if isinstance(res, dict):
+                    self._apply_spec(req, slot, res)
+                    continue
+                tok = int(res)
                 req.generated.append(tok)
                 req.pos += 1
                 if self.pages is not None:
@@ -407,6 +480,84 @@ class Scheduler:
                             tenant=req.tenant)
                 self._tenant(req.tenant).served_tokens += 1
                 self._maybe_finish(req, tok)
+            if plan["decode"].get("spec") and self._spec["drafted"]:
+                self._spec["rounds"] += 1
+                self._gauge("rlt_spec_acceptance_rate",
+                            self._spec["accepted"]
+                            / self._spec["drafted"])
+
+    def _apply_spec(self, req: ServeRequest, slot: int,
+                    res: dict) -> None:
+        """Fold one slot's draft→verify round.
+
+        The worker returns the raw programs' outputs — ``draft`` (the
+        k tokens the draft model proposed) and ``verify`` (the target's
+        k+1 greedy argmaxes over [last_token, d1..dk]).  THE SCHEDULER
+        decides acceptance: the longest prefix where draft and target
+        agree, plus the target's one corrected token.  ``verify[0]`` is
+        by construction exactly what the plain decode program would have
+        produced (same query token, same position, same cache rows), and
+        each later ``verify[j]`` conditions on ``d1..dj`` which equal
+        the accepted stream — so the emitted tokens are token-level
+        IDENTICAL to target-only greedy decode for ANY draft quality.
+
+        KV soundness: verify wrote target rows for all k+1 positions;
+        the rows past the accepted prefix hold rejected-draft garbage,
+        but the per-query position mask hides them and the next round's
+        verify overwrites them before they can ever be attended.
+
+        A ``spec_off`` request (acceptance collapsed below
+        ``min_accept``) rides the same batch but takes only
+        ``verify[0]`` and charges no draft accounting."""
+        d = [int(x) for x in res["draft"]]
+        g = [int(x) for x in res["verify"]]
+        k = len(d)
+        m = 0
+        while m < k and d[m] == g[m]:
+            m += 1
+        emit = g[:1] if req.spec_off else g[:m + 1]
+        appended = 0
+        for tok in emit:
+            req.generated.append(tok)
+            req.pos += 1
+            appended += 1
+            if self.pages is not None:
+                self.pages.on_advance(slot, req.pos)
+            self._count("rlt_serve_tokens_total", 1, tenant=req.tenant)
+            self._tenant(req.tenant).served_tokens += 1
+            self._maybe_finish(req, tok)
+            if req.state != "active":
+                break                # eos / max_new: drop the tail
+        if req.spec_off:
+            return
+        # acceptance accounting: identity ``emitted == accepted +
+        # corrected`` (serve/selfcheck.py); a truncated emission counts
+        # only what actually reached the stream
+        accepted = min(appended, m)
+        self._spec["drafted"] += k
+        self._spec["accepted"] += accepted
+        self._spec["corrected"] += appended - accepted
+        self._spec["emitted"] += appended
+        self._spec["slot_steps"] += 1
+        t = self._tenant(req.tenant)
+        t.spec_drafted += k
+        t.spec_accepted += accepted
+        self._count("rlt_spec_drafted_total", k, tenant=req.tenant)
+        self._count("rlt_spec_accepted_total", accepted,
+                    tenant=req.tenant)
+        # per-request fallback: rolling model-level agreement (m, not
+        # the truncated count — acceptance measures draft quality)
+        w = req._spec_window
+        if w is None or self.spec.min_accept <= 0.0 \
+                or req.state != "active":
+            return
+        w.append(m)
+        if len(w) >= max(1, w.maxlen // 2) \
+                and sum(w) / (len(w) * k) < self.spec.min_accept:
+            req.spec_off = True
+            self._spec["fallbacks"] += 1
+            self._count("rlt_spec_fallbacks_total", 1,
+                        tenant=req.tenant)
 
     def _maybe_finish(self, req: ServeRequest, last_token: int) -> None:
         hit_eos = (req.eos_token is not None
@@ -504,13 +655,79 @@ class Scheduler:
         self._gauge("rlt_serve_queue_depth_total", 0)
         return out
 
+    # -- KV-ship adoption (fleet disaggregation) ---------------------------
+    #
+    # A decode replica installs IMPORTED donor K/V rows (a prefill
+    # replica computed them, the router shipped the pages) as a prefix
+    # donor, so the very next admission of the matching prompt reuses
+    # the shipped rows through the normal ``kv_copy`` + suffix path —
+    # shipping plugs into prefix reuse rather than growing a second
+    # install mechanism.  Three steps because registration must come
+    # AFTER the engine's import lands on every worker: a prompt that
+    # matched a registered-but-not-yet-installed donor would kv_copy
+    # uninitialized rows (adopt → engine.import_kv → commit).
+
+    def pop_kv_export(self, req_id: int) -> "tuple | None":
+        """Claim a ship-bound prefill's piggybacked KV rows
+        (``(k_rows, v_rows, matched_tokens)``), once."""
+        with self._lock:
+            return self._kv_outbox.pop(req_id, None)
+
+    def adopt_imported(self, tokens) -> Optional[int]:
+        """Acquire (only) a slot to host shipped rows.  ``None`` when
+        paging is off or no slot can be freed — the router then falls
+        back to a pooled-mode prefill on the decode replica."""
+        if self.pages is None:
+            return None
+        tokens = np.asarray(tokens, dtype=np.int32).reshape(-1)
+        if len(tokens) < self.pages.page_size:
+            return None              # nothing page-aligned to donate
+        with self._lock:
+            if self.allocator.free_count == 0:
+                evicted = self.pages.evict_lru_donor()
+                if evicted is None:
+                    return None      # every slot live: no room to adopt
+                self.allocator.release(evicted)
+            return self.allocator.acquire()
+
+    def adopt_commit(self, slot: int, tokens) -> None:
+        """Register + retain the installed donor (rows are live on
+        every worker).  Registers directly, NOT via on_admit: these
+        rows were shipped, not prefilled — the prefix_reuse savings
+        counters must not claim them as locally-avoided compute."""
+        with self._lock:
+            reg = self.pages.index.register(
+                slot, tokens, limit=self.max_seq_len - 1)
+            if reg == 0 or not self.pages.retain(slot):
+                self.allocator.release(slot)     # unreachable guard
+
+    def adopt_abort(self, slot: int) -> None:
+        """Give the slot back (the ship failed mid-install)."""
+        with self._lock:
+            self.pages.index.drop(slot)
+            self.pages.pool.release(slot)
+            self.allocator.release(slot)
+
     # -- stats -------------------------------------------------------------
 
     def stats(self) -> dict:
         pages = {"pages": self.pages.stats()} \
             if self.pages is not None else {}
+        spec = {}
+        if self.spec is not None:
+            s = dict(self._spec)
+            s["k"] = self.spec.k
+            s["acceptance_rate"] = round(
+                s["accepted"] / s["drafted"], 4) if s["drafted"] else 0.0
+            # tokens emitted per target forward — the CPU-proxy win
+            # metric (>1 means speculation amortized target compute)
+            s["tokens_per_target_forward"] = round(
+                s["emitted"] / s["slot_steps"], 4) \
+                if s["slot_steps"] else 0.0
+            spec = {"spec": s}
         return {
             **pages,
+            **spec,
             "completed": self.completed,
             "failed": self.failed,
             "queued": self.queued_count,
@@ -522,7 +739,11 @@ class Scheduler:
             "per_tenant": {
                 name: {"active": t.active, "queued": len(t.queue),
                        "served_tokens": t.served_tokens,
-                       "quota": t.quota}
+                       "quota": t.quota,
+                       **({"acceptance_rate": round(
+                           t.spec_accepted / t.spec_drafted, 4)
+                           if t.spec_drafted else 0.0}
+                          if self.spec is not None else {})}
                 for name, t in self._tenants.items()},
         }
 

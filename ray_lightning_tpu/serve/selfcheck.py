@@ -76,6 +76,102 @@ def _check_scheduler() -> None:
           f"{st['batch_occupancy']:.2f})")
 
 
+def _check_spec_fold() -> None:
+    """Speculative-decode fold invariants, driven with fabricated
+    draft/verify results (no model): the accounting identity
+    ``emitted == accepted + corrected`` across ragged acceptance
+    patterns (accept-0, accept-k, mid-prefix), the max_new truncation,
+    and the rolling-window fallback to plain decode."""
+    import numpy as np
+    from ray_lightning_tpu.serve.scheduler import Scheduler
+    from ray_lightning_tpu.serve.spec import SpecConfig
+
+    spec = SpecConfig(enabled=True, k=3, window=4, min_accept=0.5)
+    sched = Scheduler(buckets=(8, 16), slots=2, max_seq_len=32,
+                      default_max_new_tokens=7, spec=spec)
+    req = sched.submit(np.arange(1, 5))
+    plan = sched.plan()
+    assert plan["prefills"] and plan["prefills"][0]["draft"], plan
+    slot = plan["prefills"][0]["slot"]
+    sched.apply(plan, {"prefill": {slot: 7}, "decode": {}})
+
+    def round_(draft, verify):
+        plan = sched.plan()
+        assert plan["decode"]["spec"] is True
+        sched.apply(plan, {"prefill": {}, "decode": {
+            slot: {"draft": list(draft), "verify": list(verify)}}})
+
+    round_([10, 11, 12], [10, 11, 12, 13])    # accept-k: 4 emitted
+    round_([20, 21, 22], [30, 31, 32, 33])    # accept-0: 1 corrected
+    round_([40, 41, 42], [40, 50, 51, 52])    # mid-prefix: accept 1
+    # 7 tokens total -> max_new reached mid-round (truncation leg)
+    assert req.done() and list(req.generated) == \
+        [7, 10, 11, 12, 13, 30, 40], list(req.generated)
+    s = sched.stats()["spec"]
+    assert s["emitted"] == s["accepted"] + s["corrected"] == 6, s
+    assert s["accepted"] == 4 and s["corrected"] == 2, s
+    assert s["drafted"] == 9 and s["slot_steps"] == 3, s
+    assert s["tokens_per_target_forward"] == 2.0, s
+
+    # fallback: acceptance collapses below min_accept -> spec off for
+    # the request's remaining life, verify[:1] only
+    req2 = sched.submit(np.arange(1, 5))
+    plan = sched.plan()
+    slot = plan["prefills"][0]["slot"]
+    sched.apply(plan, {"prefill": {slot: 7}, "decode": {}})
+    for i in range(2):       # window arms at window//2 = 2 entries
+        assert not req2.spec_off, i
+        round_([60 + i, 61, 62], [70 + i, 71, 72, 73])
+    assert req2.spec_off, "acceptance floor did not trip"
+    assert sched.stats()["spec"]["fallbacks"] == 1
+    plan = sched.plan()
+    assert plan["decode"].get("spec") is not True, plan["decode"]
+    print("serve selfcheck: spec fold accounting + fallback OK")
+
+
+def _check_spec_lowers() -> None:
+    """The draft and verify programs LOWER on a CPU mesh (trace-level,
+    no execution) — the program-count invariant's new members."""
+    import jax
+    import numpy as np
+
+    from ray_lightning_tpu.core.steps import (build_draft_step,
+                                              build_verify_step)
+    from ray_lightning_tpu.models.gpt import GPTConfig, GPTLightningModule
+
+    module = GPTLightningModule(GPTConfig(
+        vocab_size=64, block_size=16, n_layer=2, n_head=2, n_embd=32,
+        remat=False))
+    module.setup_model()
+    draft = module.configure_draft(layers=1)
+    aparams = jax.eval_shape(
+        module.configure_decode_model().init, jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((1, 8), np.int32))["params"]
+    adraft = jax.eval_shape(draft.init, jax.random.PRNGKey(0),
+                            jax.ShapeDtypeStruct((1, 8), np.int32)
+                            )["params"]
+    S, L, H, D, k = 2, 16, 2, 16, 3
+    kv = jax.ShapeDtypeStruct((2, S, L, H, D), draft.config.dtype)
+    dkv = jax.ShapeDtypeStruct((1, S, L, H, D), draft.config.dtype)
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, np.int32)  # noqa: E731
+    jax.jit(build_draft_step(module, k, model=draft)).lower(
+        adraft, dkv, dkv, i32(S), i32(S))
+    jax.jit(build_verify_step(module, k)).lower(
+        aparams, kv, kv, i32(S, k + 1), i32(S, k + 1))
+    print("serve selfcheck: draft/verify programs lower on a CPU mesh")
+
+
+def _check_spec_cost_model() -> None:
+    from ray_lightning_tpu.plan.cost import (expected_accepted,
+                                             speculative_speedup)
+    assert expected_accepted(1.0, 4) == 4.0
+    assert expected_accepted(0.0, 4) == 0.0
+    assert abs(expected_accepted(0.5, 2) - 0.75) < 1e-12
+    assert speculative_speedup(0.9, 4, 0.25) > 1.0
+    assert speculative_speedup(0.05, 4, 0.5) < 1.0
+    print("serve selfcheck: speculative cost model OK")
+
+
 def _check_decode_lowers() -> None:
     import jax
     import numpy as np
@@ -110,7 +206,9 @@ def _check_metric_names() -> None:
                  "rlt_serve_queue_wait_seconds",
                  "rlt_serve_traces_total",
                  "rlt_serve_prefill_seconds_total",
-                 "rlt_serve_decode_seconds_total"):
+                 "rlt_serve_decode_seconds_total",
+                 "rlt_spec_acceptance_rate", "rlt_spec_drafted_total",
+                 "rlt_spec_accepted_total", "rlt_spec_fallbacks_total"):
         validate_metric_name(name)
     print("serve selfcheck: metric names Prometheus-clean")
 
@@ -118,8 +216,11 @@ def _check_metric_names() -> None:
 def _main(argv: list) -> int:
     _check_buckets()
     _check_scheduler()
+    _check_spec_fold()
+    _check_spec_cost_model()
     _check_metric_names()
     _check_decode_lowers()
+    _check_spec_lowers()
     return 0
 
 

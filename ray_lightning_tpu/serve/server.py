@@ -67,6 +67,11 @@ class ServeSpec:
     #: paged-KV prefix reuse (serve/fleet/pages.py PageConfig); None or
     #: disabled keeps the engine's pre-fleet program set
     paged: Any = None
+    #: speculative decoding (serve/spec.py SpecConfig); None/disabled
+    #: keeps the plain-decode program set
+    spec: Any = None
+    #: build the per-bucket kv_import programs (fleet KV shipping)
+    kvship: Any = None
 
 
 class Server:
@@ -94,6 +99,8 @@ class Server:
         telemetry: Any = None,
         compile_cache: Any = None,
         paged: Any = None,
+        spec: Any = None,
+        kvship: bool = False,
         worker_env: Optional[dict] = None,
     ):
         if num_workers < 1:
@@ -119,14 +126,17 @@ class Server:
         self.telemetry = TelemetryConfig.resolve(telemetry)
         self.compile_cache = CompileCacheConfig.resolve(compile_cache)
         from ray_lightning_tpu.serve.fleet.pages import PageConfig
+        from ray_lightning_tpu.serve.spec import SpecConfig
         self.paged = PageConfig.resolve(paged)
+        self.spec = SpecConfig.resolve(spec)
+        self.kvship = bool(kvship)
         self.worker_env = dict(worker_env or {})
         self.scheduler = Scheduler(
             self.buckets, self.max_batch_slots, self.max_seq_len,
             quotas=tenant_quotas,
             max_prefills_per_step=max_prefills_per_step,
             default_max_new_tokens=max_new_tokens, eos_token=eos_token,
-            paged=self.paged)
+            paged=self.paged, spec=self.spec)
         self._weights = self._resolve_weights(module, checkpoint)
         self._backend = None
         self._workers: list = []
@@ -198,7 +208,8 @@ class Server:
                 max_seq_len=self.max_seq_len, seed=self.seed,
                 telemetry=self.telemetry,
                 compile_cache=self.compile_cache,
-                paged=self.paged)
+                paged=self.paged, spec=self.spec,
+                kvship=self.kvship)
             payload = (spec, self._weights)
             ref = None
             if backend.supports_object_store:
@@ -242,6 +253,9 @@ class Server:
                 self.telemetry.heartbeat_interval)
         env.update(self.compile_cache.worker_env())
         env.update(self.paged.worker_env())
+        env.update(self.spec.worker_env())
+        if self.kvship:
+            env["RLT_SERVE_KVSHIP"] = "1"
         env.update(self.worker_env)
         return env
 
@@ -312,9 +326,13 @@ class Server:
     # -- request surface ---------------------------------------------------
 
     def submit(self, prompt, tenant: str = "default",
-               max_new_tokens: Optional[int] = None) -> ServeRequest:
+               max_new_tokens: Optional[int] = None,
+               ship_kv: bool = False) -> ServeRequest:
         """Enqueue a prompt (token ids); returns a handle whose
-        ``result()`` blocks for the generated tokens."""
+        ``result()`` blocks for the generated tokens.  ``ship_kv``
+        marks a disaggregation prefill leg: its prefill step exports
+        the whole-page KV rows into the kv outbox alongside the step
+        result (``export_kv(..., req_id=...)`` claims them)."""
         if not self._started:
             raise RuntimeError("Server.start() first")
         if self._draining:
@@ -322,7 +340,8 @@ class Server:
         if self._error is not None:
             raise RuntimeError("serve fleet failed") from self._error
         req = self.scheduler.submit(prompt, tenant=tenant,
-                                    max_new_tokens=max_new_tokens)
+                                    max_new_tokens=max_new_tokens,
+                                    ship_kv=ship_kv)
         self._work.set()
         return req
 
@@ -332,6 +351,92 @@ class Server:
         """Blocking submit-and-wait."""
         return self.submit(prompt, tenant=tenant,
                            max_new_tokens=max_new_tokens).result(timeout)
+
+    # -- KV-page shipping (fleet disaggregation) ---------------------------
+
+    def can_ship_kv(self) -> bool:
+        """Both ends of the KV-ship channel need paging (the prefix
+        index addresses donor pages) and the kv_import programs."""
+        return self._started and self.kvship and self.paged.enabled
+
+    def export_kv(self, prompt_tokens, req_id: "int | None" = None):
+        """Donor rows for the fleet's KV-ship leg: the longest
+        registered prefix of ``prompt_tokens`` on this replica as
+        ``(k_rows, v_rows, matched_tokens)``, or ``None`` (no donor).
+        Rows are exported at bucket granularity — the import side's
+        AOT programs are per-bucket — and the importer registers only
+        the matched whole pages, so the bucket tail never decodes.
+
+        ``req_id`` (a ``submit(ship_kv=True)`` request) claims the
+        rows the prefill step piggybacked into the kv outbox — the
+        fast path with no worker round-trip; the donor match below is
+        the fallback when the outbox entry was capped out."""
+        sched = self.scheduler
+        if sched.pages is None or not self._started:
+            return None
+        if req_id is not None:
+            boxed = sched.pop_kv_export(int(req_id))
+            if boxed is not None:
+                return boxed
+        prompt_tokens = np.asarray(prompt_tokens,
+                                   dtype=np.int32).reshape(-1)
+        # match and pin under ONE lock hold: an admission evicting (and
+        # re-admitting) the donor between the match and the worker row
+        # fetch would ship a DIFFERENT prompt's rows under this
+        # prompt's registration
+        with sched._lock:
+            hit = sched.pages.match(prompt_tokens)
+            if hit is None:
+                return None
+            src, matched = hit
+            sched.pages.pin(src)
+        try:
+            from ray_lightning_tpu.serve.buckets import bucket_for
+            bucket = bucket_for(matched, self.buckets)
+            results = self._wait_all(
+                [w.call("serve_export_kv", int(src), int(bucket))
+                 for w in self._workers], timeout=120)
+            rows = next(r for r in results if r is not None)
+            return rows[0], rows[1], int(matched)
+        finally:
+            with sched._lock:
+                sched.pages.unpin(src)
+
+    def can_adopt_kv(self) -> bool:
+        """Cheap capacity probe for the router's ship policy: is there
+        a slot this replica could host shipped rows in RIGHT NOW (free,
+        or reclaimable from an LRU donor)?  Racy by design — a ship
+        admitted on a stale yes still fails safe in ``import_kv`` — but
+        it lets the router skip the quantize/mailbox/install cost of a
+        ship that is doomed before it starts (a saturated decode
+        replica under burst)."""
+        sched = self.scheduler
+        if sched.pages is None or not self._started:
+            return False
+        with sched._lock:
+            return (sched.allocator.free_count > 0
+                    or sched.pages.donor_count > 0)
+
+    def import_kv(self, prompt_tokens, k_rows, v_rows) -> bool:
+        """Adopt shipped donor rows: acquire a donor slot, install the
+        rows on every worker, then register the prefix (the order is
+        the soundness story — scheduler.adopt_commit docstring).
+        False = no adoptable slot (router falls back to pooled
+        prefill)."""
+        prompt_tokens = np.asarray(prompt_tokens,
+                                   dtype=np.int32).reshape(-1)
+        slot = self.scheduler.adopt_imported(prompt_tokens)
+        if slot is None:
+            return False
+        try:
+            self._wait_all(
+                [w.call("serve_import_kv", int(slot), k_rows, v_rows)
+                 for w in self._workers], timeout=120)
+        except BaseException:
+            self.scheduler.adopt_abort(slot)
+            raise
+        self.scheduler.adopt_commit(slot, prompt_tokens)
+        return True
 
     # -- the pump ----------------------------------------------------------
 
@@ -416,10 +521,17 @@ class Server:
             if ledger is not None:
                 # attribution rule: a dispatch that decodes produced
                 # tokens (useful); a prefill-only dispatch is context
-                # build — measured, but not goodput
+                # build — measured, but not goodput.  A speculative
+                # round splits out its draft/verify wall (worker-
+                # measured) so the ledger shows what speculation costs;
+                # the verify IS the token-producing target forward, so
+                # it stays in the useful "decode" bucket.
                 step_s = time.monotonic() - t_step
+                timing = result.get("timing") or {}
+                draft_s = float(timing.get("draft", 0.0))
                 if plan.get("decode") is not None:
-                    ledger.note_step(step_s)
+                    ledger.add("draft", min(draft_s, step_s))
+                    ledger.note_step(max(0.0, step_s - draft_s))
                 else:
                     ledger.add("prefill", step_s)
             sched.apply(plan, result)

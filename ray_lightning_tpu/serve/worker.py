@@ -71,10 +71,22 @@ class ServeWorker(RLTExecutor):
         compile_cache.activate(spec.compile_cache)
 
         from ray_lightning_tpu.serve.engine import ServeEngine
+        from ray_lightning_tpu.serve.spec import SpecConfig
+        # spec/kvship ride the pickled ServeSpec when the driver set
+        # them; otherwise the RLT_SPEC_* / RLT_SERVE_KVSHIP worker env
+        # (the fleet's replica-actor round-trip) decides here
+        sp = getattr(spec, "spec", None)
+        if sp is None:
+            sp = SpecConfig.resolve(None)
+        kvship = getattr(spec, "kvship", None)
+        if kvship is None:
+            kvship = os.environ.get(
+                "RLT_SERVE_KVSHIP", "").strip() in ("1", "true", "True")
         self._engine = ServeEngine(
             spec.module, spec.strategy, spec.buckets, spec.slots,
             spec.max_seq_len, seed=spec.seed, weights=weights,
-            paged=getattr(spec, "paged", None)).setup()
+            paged=getattr(spec, "paged", None),
+            spec=sp, kvship=bool(kvship)).setup()
         return {
             "rank": rank,
             "mesh": dict(self._engine._mesh.shape),
@@ -136,7 +148,30 @@ class ServeWorker(RLTExecutor):
             self._profiler.maybe_start(prof)
         result: dict[str, Any] = {"prefill": {}, "decode": {}}
         decode = plan.get("decode")
-        if decode is not None:
+        if decode is not None and decode.get("spec"):
+            # speculative round: k draft steps then ONE batched target
+            # verify; the SCHEDULER decides acceptance from the raw
+            # outputs (scheduler._apply_spec), workers stay stateless
+            import time as _time
+            t0 = _time.monotonic()
+            with span("draft", traces=decode.get("traces"),
+                      slots=len(decode["slots"])):
+                drafts = engine.draft(decode["tokens"],
+                                      decode["positions"])
+            t1 = _time.monotonic()
+            with span("verify", traces=decode.get("traces"),
+                      slots=len(decode["slots"])):
+                ver = engine.verify(decode["tokens"],
+                                    decode["positions"], drafts)
+            t2 = _time.monotonic()
+            for s in decode["slots"]:
+                result["decode"][s] = {
+                    "draft": [int(x) for x in drafts[s]],
+                    "verify": [int(x) for x in ver[s]]}
+            # wall attribution for the goodput ledger (server pump):
+            # draft/verify are their own buckets, not "decode"
+            result["timing"] = {"draft": t1 - t0, "verify": t2 - t1}
+        elif decode is not None:
             # ONE span for the shared decode program, fanned out to
             # every live request's tree via the slot→trace map
             with span("decode", traces=decode.get("traces"),
@@ -159,9 +194,42 @@ class ServeWorker(RLTExecutor):
                 else:
                     result["prefill"][p["slot"]] = engine.prefill(
                         p["slot"], p["tokens"], p["length"], p["bucket"])
+            if p.get("draft"):
+                # prime the draft cache for the admitted prompt (fresh
+                # AND reused admissions — the draft cache has no
+                # kv_copy plane, it always recomputes the full prefix)
+                engine.draft_prefill(p["slot"], p["tokens"],
+                                     p["length"], p["bucket"])
+            exp = p.get("export_kv")
+            if exp is not None:
+                # ship-bound prefill (disaggregation leg 1): the donor
+                # rows ride back WITH the step result, so the router's
+                # KV ship never pays a second worker round-trip nor
+                # races this slot's later eviction
+                with span("kv_export", slot=p["slot"],
+                          bucket=exp["bucket"]):
+                    rows = engine.export_kv(p["slot"], exp["bucket"])
+                result.setdefault("kv_export", {})[p["slot"]] = rows
         if self._profiler is not None:
             self._profiler.note_step()
         return result if self._rank == 0 else None
+
+    # -- KV-page shipping (fleet disaggregation) ---------------------------
+
+    def serve_export_kv(self, slot: int, bucket: int):
+        """Device→host donor rows for the router's KV-ship leg.  Runs
+        on every rank (the gather is SPMD-replicated); rank 0 alone
+        returns the payload, mirroring ``serve_step``."""
+        with span("kv_export", slot=slot, bucket=bucket):
+            rows = self._engine.export_kv(slot, bucket)
+        return rows if self._rank == 0 else None
+
+    def serve_import_kv(self, slot: int, k_rows, v_rows) -> None:
+        """Install shipped donor rows (engine ``kv_import_{b}``) —
+        dispatched on every rank to keep the SPMD fleet in lockstep."""
+        with span("kv_import", slot=slot,
+                  bucket=int(k_rows.shape[2])):
+            self._engine.import_kv(slot, k_rows, v_rows)
 
     # -- evidence / teardown -----------------------------------------------
 

@@ -57,7 +57,10 @@ UNIT_SUFFIXES = ("_bytes", "_seconds", "_total")
 #: carry.  Keep this list short and deliberate.
 UNITLESS_GAUGES = ("rlt_worker_alive", "rlt_recovery_mode",
                    "rlt_goodput_fraction", "rlt_mfu",
-                   "rlt_incident_active")
+                   "rlt_incident_active",
+                   # accepted/drafted ratio in [0, 1] — a rate carries
+                   # no unit (serve/scheduler.py speculative decode)
+                   "rlt_spec_acceptance_rate")
 
 #: step-time histogram bounds (seconds): sub-ms dispatch latency up to
 #: multi-second giant-model steps
@@ -150,6 +153,19 @@ CORE_METRICS = (
     # and ranked verdict, plus how many incidents are open right now
     "rlt_incident_total",
     "rlt_incident_active",
+    # speculative decode (serve/scheduler.py): draft/accept accounting
+    # per tenant plus the rolling acceptance-rate gauge
+    "rlt_spec_drafted_total",
+    "rlt_spec_accepted_total",
+    "rlt_spec_fallbacks_total",
+    "rlt_spec_acceptance_rate",
+    # disaggregated decode (serve/fleet/router.py): KV-page shipping
+    # over the peer channel — wire bytes by codec, chaos retries, and
+    # per-request pooled-mode failovers
+    "rlt_kvship_ships_total",
+    "rlt_kvship_bytes_total",
+    "rlt_kvship_retries_total",
+    "rlt_kvship_failovers_total",
 )
 
 

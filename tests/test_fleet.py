@@ -45,6 +45,36 @@ def test_pick_replica_least_loaded_sticky_slack():
                         sticky_slack=0) == 1             # past slack
     assert pick_replica([]) is None
 
+def test_pick_replica_pool_routing():
+    rows = [{"rid": 0, "active": 2, "queued": 0, "slots": 4,
+             "role": "prefill"},
+            {"rid": 1, "active": 0, "queued": 0, "slots": 4,
+             "role": "decode"},
+            {"rid": 2, "active": 1, "queued": 0, "slots": 4,
+             "role": "prefill"}]
+    assert pick_replica(rows, pool="prefill") == 2   # least loaded in pool
+    assert pick_replica(rows, pool="decode") == 1
+    # a pool that emptied (shrink/failover) degrades to pooled routing
+    assert pick_replica([rows[0], rows[2]], pool="decode") == 2
+    # bare rows carry no role: the filter matches nothing, falls back
+    bare = [{"rid": 5, "active": 0, "queued": 0, "slots": 2}]
+    assert pick_replica(bare, pool="prefill") == 5
+
+
+def test_fleet_roles_config_validation_and_env_roundtrip(monkeypatch):
+    cfg = FleetConfig(roles=("prefill", "decode"), kvship_codec="int8")
+    for k, v in cfg.worker_env().items():
+        monkeypatch.setenv(k, v)
+    assert FleetConfig.resolve(None) == cfg
+    assert [cfg.role_for(i) for i in range(4)] == \
+        ["prefill", "decode", "prefill", "decode"]
+    assert FleetConfig().role_for(3) == "pooled"     # no roles: pooled
+    with pytest.raises(ValueError, match="role"):
+        FleetConfig(roles=("prefill", "verify"))
+    with pytest.raises(ValueError, match="kvship_codec"):
+        FleetConfig(kvship_codec="zstd")
+
+
 PAGED = PageConfig(enabled=True, page_size=8)
 
 
@@ -680,6 +710,64 @@ def test_fleet_e2e_autoscale_grow_shrink_local_backend(tmp_path, seed):
                       slots=4, max_seq_len=32, seed=0).setup()
     for r, out in zip(reqs, outs):
         _assert_greedy_parity(eng, r.prompt, out.tolist())
+
+
+@pytest.mark.slow
+def test_disagg_roles_ship_resume_parity_and_chaos_failover(
+        tmp_path, seed, monkeypatch):
+    """Disaggregated decode e2e on the local backend: a 1-prefill +
+    1-decode fleet serves every request with tokens IDENTICAL to a
+    pooled fleet's (ship -> resume parity; raw ships fp32 and is
+    bit-exact, fp8 rides the wire >= 3x smaller under the same bar),
+    sub-page prompts stay pooled, and a chaos-dropped ship exhausts
+    its bounded retries (RLT_PEER_RETRIES) then fails over PER-REQUEST
+    to a local prefill — same tokens, counted failover."""
+    from ray_lightning_tpu.models.gpt import GPTLightningModule
+    from ray_lightning_tpu.serve.fleet import FleetServer
+
+    monkeypatch.setenv("RLT_PEER_RETRIES", "2")
+    monkeypatch.setenv("RLT_PEER_BACKOFF_S", "0.01")
+    monkeypatch.setenv("RLT_KVSHIP_TIMEOUT_S", "0.05")
+    module = GPTLightningModule(_tiny())
+    kw = _real_server_kwargs(tmp_path)
+    shared = np.arange(1, 17)                  # 2 whole pages
+    prompts = [np.concatenate([shared, [20 + i]]) for i in range(3)]
+    prompts.append(np.arange(1, 7))            # sub-page: stays pooled
+
+    def serve(tag, fleet_cfg):
+        fleet = FleetServer(
+            module, replicas=2, autoscale=False, fleet=fleet_cfg,
+            paged={"page_size": 8},
+            default_root_dir=str(tmp_path / tag), **kw).start()
+        outs, kv = [], None
+        try:
+            # sequential: each ship sees its own fresh donor pages
+            outs = [fleet.generate(p, timeout=180).tolist()
+                    for p in prompts]
+            if fleet_cfg:
+                fleet.arm_kvship_drop(1)
+                outs.append(fleet.generate(prompts[0],
+                                           timeout=180).tolist())
+            kv = fleet.status()["fleet"].get("kvship")
+        finally:
+            fleet.shutdown()
+        return outs, kv
+
+    want, kv = serve("pooled", None)
+    assert kv is None                  # pooled fleets carry no kvship
+    for codec in ("raw", "fp8"):
+        outs, kv = serve(codec, {"roles": ("prefill", "decode"),
+                                 "kvship_codec": codec})
+        # clean legs: exact ship->resume token parity vs pooled
+        assert outs[:len(prompts)] == want, codec
+        # chaos leg replays prompt 0: identical tokens via failover
+        assert outs[-1] == want[0], codec
+        assert kv["ships"] == 3 and kv["failovers"] == 1, kv
+        assert kv["retries"] == 2, kv      # bounded: RLT_PEER_RETRIES
+        if codec == "fp8":
+            assert kv["compression_ratio"] >= 3.0, kv
+        else:
+            assert kv["compression_ratio"] == 1.0, kv
 
 
 @pytest.mark.slow

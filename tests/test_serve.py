@@ -616,6 +616,115 @@ def test_e2e_two_workers_multi_tenant_live_metrics(tmp_path, seed,
         server2.shutdown()
 
 
+def _spec_round(sched, slot, draft, verify):
+    """One fabricated speculative round: k draft tokens + k+1 verify
+    tokens for ``slot``, applied through the real fold."""
+    plan = sched.plan()
+    assert plan["decode"]["spec"] is True, plan["decode"]
+    sched.apply(plan, {"prefill": {}, "decode": {
+        slot: {"draft": list(draft), "verify": list(verify)}}})
+
+
+def test_spec_scheduler_ragged_fold_and_fallback():
+    """Speculative-decode fold invariants against fabricated
+    draft/verify results (no jax work): the accounting identity
+    ``emitted == accepted + corrected`` across ragged acceptance
+    (accept-k, accept-0, mid-prefix), max_new truncation mid-round,
+    and the rolling-window acceptance floor falling back to plain
+    decode for the request's remaining life."""
+    from ray_lightning_tpu.serve.spec import SpecConfig
+    spec = SpecConfig(enabled=True, k=3, window=4, min_accept=0.5)
+    sched = Scheduler(buckets=(8, 16), slots=2, max_seq_len=32,
+                      default_max_new_tokens=7, spec=spec)
+    req = sched.submit(np.arange(1, 5))
+    plan = sched.plan()
+    assert plan["prefills"] and plan["prefills"][0]["draft"], plan
+    slot = plan["prefills"][0]["slot"]
+    sched.apply(plan, {"prefill": {slot: 7}, "decode": {}})
+    _spec_round(sched, slot, [10, 11, 12], [10, 11, 12, 13])  # accept-k
+    _spec_round(sched, slot, [20, 21, 22], [30, 31, 32, 33])  # accept-0
+    _spec_round(sched, slot, [40, 41, 42], [40, 50, 51, 52])  # mid-prefix
+    # 7 tokens total -> max_new reached mid-round (truncation leg)
+    assert req.done() and list(req.generated) == \
+        [7, 10, 11, 12, 13, 30, 40], list(req.generated)
+    s = sched.stats()["spec"]
+    assert s["emitted"] == s["accepted"] + s["corrected"] == 6, s
+    assert (s["accepted"], s["corrected"], s["drafted"]) == (4, 2, 9), s
+    assert s["slot_steps"] == 3 and s["tokens_per_target_forward"] == 2.0
+
+    # acceptance collapse: two all-reject rounds fill half the window
+    # below min_accept -> spec off for this request, verify[:1] only
+    req2 = sched.submit(np.arange(1, 5))
+    plan = sched.plan()
+    slot = plan["prefills"][0]["slot"]
+    sched.apply(plan, {"prefill": {slot: 7}, "decode": {}})
+    for i in range(2):
+        assert not req2.spec_off, i
+        _spec_round(sched, slot, [60 + i, 61, 62], [70 + i, 71, 72, 73])
+    assert req2.spec_off, "acceptance floor did not trip"
+    assert sched.stats()["spec"]["fallbacks"] == 1
+    plan = sched.plan()
+    assert plan["decode"].get("spec") is not True, plan["decode"]
+
+
+def test_spec_server_greedy_parity_across_draft_depths(tmp_path, seed,
+                                                       engine):
+    """Full-stack speculative decoding on a real 1-worker Server:
+    outputs must equal the plain server's token-for-token REGARDLESS
+    of draft quality — parity is by construction of the verify fold,
+    acceptance only moves throughput.  Three legs share one compile
+    cache: plain (reference), a full-clone draft (draft == target, so
+    every drafted token verifies: acceptance 1.0, zero fallbacks), and
+    a layer-truncated int8-resident draft (the deployment shape, plus
+    the draft-weight HBM saving in stats)."""
+    module = GPTLightningModule(TINY)
+    prompts = [np.arange(1, 4 + (i % 5)) for i in range(4)]
+
+    def run(tag, spec):
+        server = Server(
+            module, num_workers=1, platform="cpu", buckets=(8, 16),
+            max_batch_slots=4, max_new_tokens=8,
+            default_root_dir=str(tmp_path / tag),
+            compile_cache=str(tmp_path / "compile_cache"),
+            telemetry=False, spec=spec)
+        try:
+            server.start()
+            reqs = [server.submit(p, tenant="alice") for p in prompts]
+            outs = [r.result(timeout=180).tolist() for r in reqs]
+            stats = server.stats()
+        finally:
+            server.shutdown()
+        return outs, stats
+
+    plain, _ = run("plain", None)
+    for out, prompt in zip(plain, prompts):
+        _assert_greedy_parity(engine, prompt, out)
+
+    clone, cstats = run("clone", {"k": 3, "draft_layers": TINY.n_layer})
+    assert clone == plain, "full-clone spec decode broke greedy parity"
+    sp = cstats["scheduler"]["spec"]
+    # identical weights, but the draft's unrolled program and the
+    # batched verify forward fuse differently — bf16 near-ties can
+    # flip an argmax between them, so acceptance is high, not 1.0
+    # (and the fold corrects every flip: parity above stays exact)
+    assert sp["acceptance_rate"] >= 0.8 and sp["fallbacks"] == 0, sp
+    assert sp["emitted"] == sp["accepted"] + sp["corrected"], sp
+    assert sp["tokens_per_target_forward"] > 2.0, sp
+
+    trunc, tstats = run("int8", {"k": 3, "draft_layers": 1,
+                                 "min_accept": 0.05,
+                                 "draft_quant": "int8"})
+    assert trunc == plain, "truncated-draft spec broke greedy parity"
+    sp = tstats["scheduler"]["spec"]
+    assert sp["emitted"] == sp["accepted"] + sp["corrected"], sp
+    assert sp["tokens_per_target_forward"] >= 1.0, sp
+    for w in tstats["workers"]:
+        assert all(v == 0 for v in w["retraces"].values()), w
+        # int8 residency: the draft copy costs LESS HBM than a
+        # dedicated bf16 draft would
+        assert w["spec"]["draft_hbm_delta_bytes"] < 0, w["spec"]
+
+
 def test_server_weights_roundtrip_from_trained_module(tmp_path, seed):
     """The train->serve weights handoff: an engine built from restored
     weights (module._trained_variables / checkpoint state-dict shape)
