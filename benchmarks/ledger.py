@@ -64,6 +64,14 @@ forward``                       lower   ``serve_band`` + 5-point
 ``fleet.disagg.fp8_
 compression_ratio``             lower   ``serve_band`` (KV wire bytes
                                         vs the raw fp32 control)
+``fleet.federated_reuse_
+ratio``                         lower   ``serve_band`` + 2-point
+                                        absolute floor (prefix pages
+                                        PULLED from other replicas on
+                                        the fed-on leg — a collapse
+                                        means the directory stopped
+                                        federating even while
+                                        tokens/s holds)
 ==============================  ======  ==============================
 
 Improvements are reported too (the ledger is a trajectory, not just an
@@ -271,6 +279,15 @@ def compare(prev: Any, curr: Any, *, step_band: float = STEP_BAND,
                       pd.get("fp8_compression_ratio"),
                       cd.get("fp8_compression_ratio"), "lower",
                       serve_band)
+            # prefix federation (bench_fleet.py fed-on leg): fraction
+            # of requested prefill tokens satisfied by pages PULLED
+            # from another replica over the kvship plane — lower means
+            # the directory stopped federating, a regression even
+            # while tokens/s holds on the CPU proxy
+            check(metric, f"{key}.federated_reuse_ratio",
+                  ps.get("federated_reuse_ratio"),
+                  cs.get("federated_reuse_ratio"), "lower", serve_band,
+                  floor=MIN_GOODPUT_DELTA)
         # goodput plane (telemetry/goodput.py `goodput` dict): the
         # useful-fraction of run wall and measured MFU are both
         # lower-is-worse; one-sided presence (a pre-goodput baseline)

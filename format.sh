@@ -66,7 +66,10 @@ if [[ "$CHECK" == 1 ]]; then
     # RLT_FLEET*/RLT_SERVE_PAGED* env round-trip, page free-list
     # accounting, prefix-hash round-trip (collision-verified), the
     # autoscaler patience/cooldown state machine, router least-loaded/
-    # sticky/quota invariants, rlt_fleet_* metric names
+    # sticky/affinity/quota invariants, the federation directory
+    # (register/lookup/invalidate round-trip, liveness expiry,
+    # collision-proof routing, retained-page size bound),
+    # rlt_fleet_* metric names
     # (ray_lightning_tpu/serve/fleet/selfcheck.py)
     python -c 'import sys; from ray_lightning_tpu.serve.fleet.selfcheck \
         import _main; sys.exit(_main([]))'

@@ -13,6 +13,10 @@ Layout:
   patience + cooldown debouncing
 - ``pages.py``     — paged KV accounting + the prefix-hash index behind
   "shared system prompts prefill once per replica"
+- ``federation.py`` — :class:`PrefixDirectory`: the router-resident
+  fleet-wide donor registry behind "prefill once per FLEET" — replicas
+  advertise retained prefixes, admissions on other replicas pull the
+  pages over the KV-ship plane instead of re-prefilling
 - ``config.py``    — :class:`FleetConfig` (+ the RLT_FLEET* env
   round-trip)
 - ``selfcheck.py`` — dependency-light invariants for
@@ -23,6 +27,9 @@ from ray_lightning_tpu.serve.fleet.autoscale import (  # noqa: F401
     Autoscaler,
 )
 from ray_lightning_tpu.serve.fleet.config import FleetConfig  # noqa: F401
+from ray_lightning_tpu.serve.fleet.federation import (  # noqa: F401
+    PrefixDirectory,
+)
 from ray_lightning_tpu.serve.fleet.pages import (  # noqa: F401
     PageConfig,
     PagedKV,
@@ -49,6 +56,7 @@ __all__ = [
     "PageConfig",
     "PagedKV",
     "PagePool",
+    "PrefixDirectory",
     "PrefixIndex",
     "pick_replica",
 ]

@@ -51,6 +51,21 @@ class FleetConfig:
     kvship_codec: wire codec for shipped KV pages (comm/quant.py):
         ``"fp8"`` (default), ``"int8"``, ``"int4"``, ``"bf16"``, or
         ``"raw"`` (the uncompressed fp32 A/B control leg).
+    prefix_fed: fleet-level prefix-cache federation
+        (serve/fleet/federation.py): replicas advertise retained
+        donors to a router-resident directory, and an admission whose
+        prefix lives on ANOTHER replica fetches the pages over the
+        KV-ship plane instead of re-prefilling — shared prompts
+        prefill once per FLEET, not once per replica.  Requires
+        paging; off keeps routing and reuse per-replica.
+    prefix_fed_ttl_s: directory-entry liveness window — an
+        advertisement older than this is treated as dead (a wedged
+        replica's donors age out instead of attracting doomed
+        fetches).
+    prefix_fed_fetches: max concurrent federated fetches (the
+        capacity gate): a directory hit past this budget dispatches
+        normally and prefills locally rather than queueing behind the
+        wire.
     """
 
     min_replicas: int = 1
@@ -64,6 +79,9 @@ class FleetConfig:
     sticky_slack: int = 1
     roles: "tuple[str, ...]" = ()
     kvship_codec: str = "fp8"
+    prefix_fed: bool = False
+    prefix_fed_ttl_s: float = 30.0
+    prefix_fed_fetches: int = 2
 
     def __post_init__(self):
         if self.min_replicas < 1:
@@ -92,6 +110,10 @@ class FleetConfig:
             raise ValueError(
                 f"kvship_codec {self.kvship_codec!r}: must be one of "
                 f"{CODEC_MODES + ('raw',)}")
+        if self.prefix_fed_ttl_s <= 0:
+            raise ValueError("fleet prefix_fed_ttl_s must be > 0")
+        if self.prefix_fed_fetches < 1:
+            raise ValueError("fleet prefix_fed_fetches must be >= 1")
 
     # -- construction ----------------------------------------------------
 
@@ -128,6 +150,13 @@ class FleetConfig:
                 if r.strip()),
             kvship_codec=os.environ.get(
                 "RLT_KVSHIP_CODEC", "fp8").strip() or "fp8",
+            prefix_fed=os.environ.get(
+                "RLT_FLEET_PREFIX_FED", "").strip()
+            in ("1", "true", "True"),
+            prefix_fed_ttl_s=float(os.environ.get(
+                "RLT_FLEET_PREFIX_FED_TTL", "30") or 30),
+            prefix_fed_fetches=int(os.environ.get(
+                "RLT_FLEET_PREFIX_FED_FETCHES", "2") or 2),
         )
 
     # -- env round-trip --------------------------------------------------
@@ -151,6 +180,13 @@ class FleetConfig:
             env["RLT_FLEET_ROLES"] = ",".join(self.roles)
         if self.kvship_codec != "fp8":
             env["RLT_KVSHIP_CODEC"] = self.kvship_codec
+        if self.prefix_fed:
+            env["RLT_FLEET_PREFIX_FED"] = "1"
+        if self.prefix_fed_ttl_s != 30.0:
+            env["RLT_FLEET_PREFIX_FED_TTL"] = repr(self.prefix_fed_ttl_s)
+        if self.prefix_fed_fetches != 2:
+            env["RLT_FLEET_PREFIX_FED_FETCHES"] = \
+                str(self.prefix_fed_fetches)
         return env
 
     def role_for(self, index: int) -> str:

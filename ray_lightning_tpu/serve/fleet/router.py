@@ -11,7 +11,13 @@ behaviors on the driver:
   tenant stickiness as the tiebreak inside ``sticky_slack``: a tenant's
   requests keep landing on the replica that already holds its prefix
   pages (serve/fleet/pages.py KV affinity), but never at the price of
-  real load imbalance.  Per-tenant quotas are enforced FLEET-WIDE on
+  real load imbalance.  With ``fleet={"prefix_fed": True}`` the
+  router-resident prefix directory (serve/fleet/federation.py) goes
+  first: the replica MEASURED to hold the longest matching prefix wins
+  inside the same slack, and a prefix held only on another replica is
+  pulled over the KV-ship plane before admission — shared prompts
+  prefill once per fleet, not once per replica.  Per-tenant quotas are
+  enforced FLEET-WIDE on
   dispatched in-flight requests (the per-replica schedulers run
   unquoted); a tenant at quota parks in the fleet queue without
   head-of-line-blocking other tenants.
@@ -58,7 +64,7 @@ import numpy as np
 
 from ray_lightning_tpu.serve.fleet.autoscale import Autoscaler
 from ray_lightning_tpu.serve.fleet.config import FleetConfig
-from ray_lightning_tpu.serve.fleet.pages import PageConfig
+from ray_lightning_tpu.serve.fleet.pages import PageConfig, _prefix_hash
 from ray_lightning_tpu.serve.fleet.replica import FleetReplica
 from ray_lightning_tpu.telemetry import metrics as _metrics
 
@@ -68,7 +74,8 @@ _log = logging.getLogger(__name__)
 def pick_replica(rows: "list[dict]", sticky_rid: Optional[int] = None,
                  sticky_slack: int = 1,
                  pool: Optional[str] = None,
-                 spill: bool = False) -> Optional[int]:
+                 spill: bool = False,
+                 affinity: "Optional[dict]" = None) -> Optional[int]:
     """Routing policy (pure — fleet/selfcheck.py drives it directly).
 
     ``rows``: one ``{"rid", "active", "queued", "slots"[, "role"]}``
@@ -77,6 +84,14 @@ def pick_replica(rows: "list[dict]", sticky_rid: Optional[int] = None,
     sticky replica overrides the winner only while its load is within
     ``sticky_slack`` of the winner on BOTH axes — KV affinity must
     never hide a hot replica.
+
+    ``affinity`` (prefix federation): ``{rid: matched_prefix_tokens}``
+    from the fleet directory — the replica already holding the LONGEST
+    matching prefix beats least-loaded (and beats stickiness: measured
+    pages outrank a routing habit), under the SAME slack discipline:
+    a prefix hit never justifies routing onto a hot replica, because
+    past the slack the pages can be fetched instead (the federation's
+    whole point).
 
     ``pool`` restricts routing to one disaggregation role ("prefill" /
     "decode"); when NO row carries that role the filter falls back to
@@ -103,6 +118,14 @@ def pick_replica(rows: "list[dict]", sticky_rid: Optional[int] = None,
                         and r["active"] == 0 and r["queued"] == 0]
             rows = pooled
     best = min(rows, key=lambda r: (r["active"], r["queued"], r["rid"]))
+    if affinity:
+        near = [r for r in rows
+                if affinity.get(r["rid"], 0) > 0
+                and r["active"] <= best["active"] + sticky_slack
+                and r["queued"] <= best["queued"] + sticky_slack]
+        if near:
+            return max(near, key=lambda r: (affinity[r["rid"]],
+                                            -r["rid"]))["rid"]
     if sticky_rid is not None and sticky_rid != best["rid"]:
         for r in rows:
             if r["rid"] == sticky_rid \
@@ -237,7 +260,9 @@ class FleetServer:
         #: shrink doesn't erase the fleet's reuse evidence
         self._retired_pages = {"prefill_tokens_requested": 0,
                                "prefill_tokens_computed": 0,
-                               "prefix_hits": 0, "reused_prefills": 0}
+                               "prefix_hits": 0, "reused_prefills": 0,
+                               "remote_imports": 0,
+                               "federated_tokens_reused": 0}
         #: finalized goodput docs of removed replicas (same rationale:
         #: a shrink must not erase the fleet's wall-clock attribution)
         self._retired_goodput: list = []
@@ -259,6 +284,37 @@ class FleetServer:
         self.kvship = {"codec": cfg.kvship_codec, "ships": 0,
                        "bytes_wire": 0, "bytes_raw": 0, "retries": 0,
                        "failovers": 0, "skipped": 0}
+        #: prefix federation (serve/fleet/federation.py): the router-
+        #: resident directory every replica's PagedKV advertises donor
+        #: retentions to; a directory hit for a prefix the admitting
+        #: replica lacks pulls the pages over the SAME kvship plane
+        #: (shared counters, reason="federation" on the metrics)
+        self.directory = None
+        if cfg.prefix_fed and self.paged.enabled:
+            from ray_lightning_tpu.serve.fleet.federation import \
+                PrefixDirectory
+            self.directory = PrefixDirectory(
+                self.paged.page_size, ttl_s=cfg.prefix_fed_ttl_s)
+        self._kvfed_seconds = 0.0
+        #: in-flight federated fetches, keyed (target rid, prefix hash)
+        #: — the capacity gate AND the dedupe (N queued requests with
+        #: one shared prefix must not fetch it N times)
+        self._fed_inflight: set = set()
+        self.federation = {"codec": cfg.kvship_codec, "hits": 0,
+                           "fetches": 0, "ships": 0, "bytes_wire": 0,
+                           "bytes_raw": 0, "retries": 0, "failovers": 0,
+                           "skipped": 0}
+        # chaos: an RLT_FAULT peerdrop spec arms the router's kvship
+        # mailbox exactly like it arms the worker↔worker peer channel
+        # (elastic/faults.py) — serve workers never install the
+        # training-side FaultInjector, so the spec is unambiguous here
+        raw_fault = os.environ.get("RLT_FAULT", "").strip()
+        if raw_fault and "peerdrop" in raw_fault \
+                and (cfg.roles or self.directory is not None):
+            from ray_lightning_tpu.elastic.faults import parse_faults
+            for spec in parse_faults(raw_fault):
+                if spec.kind == "peerdrop":
+                    self._kvship_drop += spec.count
 
     # -- construction ------------------------------------------------------
 
@@ -293,10 +349,12 @@ class FleetServer:
             tenant_quotas=None,
             telemetry=rep_telemetry,
             paged=self.paged,
-            # roles configured → every replica can ship/receive KV
-            # pages (the per-bucket import programs are cheap and a
-            # failback-to-pooled replica may still receive a ship)
-            kvship=bool(self.cfg.roles) and self.paged.enabled,
+            # roles or federation configured → every replica can
+            # ship/receive KV pages (the per-bucket import programs
+            # are cheap and a failback-to-pooled replica may still
+            # receive a ship or a federated fetch)
+            kvship=(bool(self.cfg.roles) or self.directory is not None)
+            and self.paged.enabled,
             default_root_dir=os.path.join(self.default_root_dir,
                                           f"replica_{rid}"),
             worker_env=worker_env,
@@ -309,6 +367,10 @@ class FleetServer:
             rep = FleetReplica(rid, self._factory(rid),
                                role=self.cfg.role_for(rid))
             self._replicas[rid] = rep
+        if self.directory is not None:
+            pages = getattr(rep.server.scheduler, "pages", None)
+            if pages is not None:
+                pages.bind_federation(rid, self.directory)
         return rep
 
     # -- lifecycle ---------------------------------------------------------
@@ -564,14 +626,18 @@ class FleetServer:
         self._wake.set()
 
     def _fold_pages(self, rep: FleetReplica) -> None:
-        """Preserve a departing replica's prefix-reuse counters."""
+        """Preserve a departing replica's prefix-reuse counters (and
+        drop its directory advertisements — a dead donor must stop
+        attracting fetches)."""
+        if self.directory is not None:
+            self.directory.invalidate_replica(rep.id)
         pages = getattr(rep.server.scheduler, "pages", None)
         if pages is None:
             return
         st = pages.stats()
         with self._lock:
             for key in self._retired_pages:
-                self._retired_pages[key] += st[key]
+                self._retired_pages[key] += st.get(key, 0)
 
     def _fold_goodput(self, rep: FleetReplica) -> None:
         """Preserve a departing replica's goodput partition (the pump
@@ -614,12 +680,20 @@ class FleetServer:
                         self._tenant_inflight.get(fr.tenant, 0) >= quota:
                     continue   # tenant at fleet-wide quota; others pass
                 disagg = self._disagg_eligible(fr, reps)
+                # prefix-affinity routing: the directory knows which
+                # replica already holds the longest matching prefix —
+                # land there when its load allows, fetch otherwise
+                aff = None
+                if self.directory is not None \
+                        and len(fr.prompt) >= self.paged.page_size:
+                    aff = self.directory.affinity(fr.prompt)
                 if disagg:
                     # disaggregated: the prefill pool computes the
                     # prompt (ONE token), its KV pages ship, a decode
                     # replica finishes the request (_advance_disagg)
                     rid = pick_replica(list(rows.values()),
-                                       None, 0, pool="prefill")
+                                       None, 0, pool="prefill",
+                                       affinity=aff)
                 else:
                     # with roles configured, pooled traffic routes to
                     # the DECODE pool: a full request parked on a
@@ -632,10 +706,29 @@ class FleetServer:
                                        self.cfg.sticky_slack,
                                        pool="decode" if self.cfg.roles
                                        else None,
-                                       spill=bool(self.cfg.roles))
+                                       spill=bool(self.cfg.roles),
+                                       affinity=aff)
                 if rid is None:
                     break
                 rep = reps[rid]
+                fetch = self._plan_fed_fetch(fr, rid, aff)
+                if fetch is not None:
+                    # a replica OTHER than the routed one holds a
+                    # longer prefix: pull the pages first (off-pump,
+                    # capacity-gated), then submit — the admission
+                    # lands on freshly-installed donor rows and
+                    # prefills only the suffix
+                    self._pending.remove(fr)
+                    self._inflight[fr.id] = fr
+                    self._tenant_inflight[fr.tenant] = \
+                        self._tenant_inflight.get(fr.tenant, 0) + 1
+                    if not disagg:
+                        self._sticky[fr.tenant] = rid
+                    self._fed_inflight.add(fetch[3])
+                    self._kvship_executor().submit(
+                        self._fed_fetch_task, fr, fetch, rid, disagg)
+                    rows[rid]["queued"] += 1
+                    continue
                 try:
                     if disagg:
                         # piggyback the KV export only when the decode
@@ -758,7 +851,9 @@ class FleetServer:
         src = self._replicas.get(fr.replica)
         shipped = False
         if src is not None:
-            shipped = self._ship_kv(src, rep, fr)
+            shipped = self._ship_kv(
+                src, rep, fr.prompt, fr.id,
+                req_id=getattr(fr.inner, "id", None)) == "ok"
         prompt2 = np.concatenate(
             [fr.prompt, np.asarray([t1], dtype=np.int32)])
         remaining = None if want is None else want - 1
@@ -775,36 +870,45 @@ class FleetServer:
                           "shipped": shipped}
 
     def _ship_kv(self, src: FleetReplica, dst: FleetReplica,
-                 fr: FleetRequest) -> bool:
+                 prompt: np.ndarray, fid: int,
+                 req_id: Optional[int] = None,
+                 reason: str = "disagg") -> str:
         """One KV-page ship over the peer channel: export the donor
-        rows from the prefill replica, codec-compress them onto the
-        mailbox, take with retry/backoff (RLT_PEER_RETRIES), decode
-        and install on the decode replica.  False = the decode leg
-        must prefill for itself (per-request pooled failover)."""
+        rows from ``src``, codec-compress them onto the mailbox, take
+        with retry/backoff (RLT_PEER_RETRIES), decode and install on
+        ``dst``.  Returns a status string — ``"ok"`` (installed),
+        ``"stale"`` (the donor vanished between lookup and export:
+        federation invalidates the directory entry), ``"busy"`` (no
+        adoptable slot on ``dst``), ``"timeout"`` (wire chaos / dead
+        peer), ``"error"``.  Anything but ``"ok"`` means the consumer
+        prefills for itself (per-request local failover); bookkeeping
+        lands in ``self.kvship`` for the disagg push path or
+        ``self.federation`` for the pull path, and wall-clock in the
+        matching goodput bucket (kv_ship vs kv_fed)."""
         from ray_lightning_tpu.cluster.peer import PeerTimeout, \
             _retry_policy
         from ray_lightning_tpu.comm.quant import dequantize_blob, \
             quantize_blob
         t0 = time.monotonic()
         codec = self.cfg.kvship_codec
-        prompt, fid = fr.prompt, fr.id
+        stats = self.kvship if reason == "disagg" else self.federation
         try:
-            # the leg-1 request's prefill piggybacked its rows into
-            # the prefill replica's kv outbox (claimed by req_id) —
-            # no worker round-trip, no donor-eviction race
-            exported = src.server.export_kv(
-                prompt, req_id=getattr(fr.inner, "id", None))
+            # a disagg leg-1 prefill piggybacked its rows into the
+            # prefill replica's kv outbox (claimed by req_id) — no
+            # worker round-trip; federation pulls fall through to the
+            # pin-under-lock donor-match export
+            exported = src.server.export_kv(prompt, req_id=req_id)
             if exported is None:
-                self.kvship["skipped"] += 1
-                return False
+                stats["skipped"] += 1
+                return "stale"
             if hasattr(dst.server, "can_adopt_kv") \
                     and not dst.server.can_adopt_kv():
                 # every destination slot is live: the install would
                 # fail after paying quantize + mailbox + a worker
-                # round-trip — skip up front and let the decode leg
+                # round-trip — skip up front and let the consumer
                 # prefill for itself (same fallback, none of the cost)
-                self.kvship["skipped"] += 1
-                return False
+                stats["skipped"] += 1
+                return "busy"
             k_rows, v_rows, matched = exported
             kp, ks = quantize_blob(k_rows, codec)
             vp, vs = quantize_blob(v_rows, codec)
@@ -819,7 +923,7 @@ class FleetServer:
             wire = sum(a.nbytes for pair in (payload["k"], payload["v"])
                        for a in pair if a is not None)
             raw = 2 * int(np.prod(k_rows.shape)) * 4   # fp32 baseline
-            tag = ("kvship", int(fid))
+            tag = ("kvship", reason, int(fid))
             with self._lock:
                 drop = self._kvship_drop > 0
                 if drop:
@@ -836,20 +940,23 @@ class FleetServer:
                     src=f"prefill replica {src.id}")
             except PeerTimeout as e:
                 retries, _ = _retry_policy()
-                self.kvship["retries"] += retries
-                self.kvship["failovers"] += 1
-                self._count("rlt_kvship_retries_total", max(1, retries))
-                self._count("rlt_kvship_failovers_total", 1)
+                stats["retries"] += retries
+                stats["failovers"] += 1
+                self._count("rlt_kvship_retries_total", max(1, retries),
+                            reason=reason)
+                self._count("rlt_kvship_failovers_total", 1,
+                            reason=reason)
                 if self._agg is not None:
                     # correlation event: the flight-dump / incident
                     # timeline names the failover cause next to the
                     # latency it explains
                     self._agg.note_event(
                         "kvship_failover", request=int(fid),
-                        src=src.id, dst=dst.id, cause=repr(e))
+                        src=src.id, dst=dst.id, reason=reason,
+                        cause=repr(e))
                 _log.warning("kvship failover for fleet request %d: %s",
                              fid, e)
-                return False
+                return "timeout"
             k2 = dequantize_blob(got["k"][0], got["k"][1],
                                  got["codec"], got["shape"])
             v2 = dequantize_blob(got["v"][0], got["v"][1],
@@ -857,23 +964,133 @@ class FleetServer:
             if not dst.server.import_kv(got["tokens"],
                                         np.asarray(k2),
                                         np.asarray(v2)):
-                self.kvship["skipped"] += 1
-                return False
-            self.kvship["ships"] += 1
-            self.kvship["bytes_wire"] += wire
-            self.kvship["bytes_raw"] += raw
-            self._count("rlt_kvship_ships_total", 1, codec=codec)
-            self._count("rlt_kvship_bytes_total", wire, codec=codec)
-            return True
+                stats["skipped"] += 1
+                return "busy"
+            stats["ships"] += 1
+            stats["bytes_wire"] += wire
+            stats["bytes_raw"] += raw
+            self._count("rlt_kvship_ships_total", 1, codec=codec,
+                        reason=reason)
+            # wire vs raw as separate label series: the live fp8
+            # compression ratio is wire/raw straight off /metrics
+            self._count("rlt_kvship_bytes_total", wire, codec=codec,
+                        reason=reason, kind="wire")
+            self._count("rlt_kvship_bytes_total", raw, codec=codec,
+                        reason=reason, kind="raw")
+            return "ok"
         except Exception:
-            _log.warning("kvship failed; decode leg prefills locally",
+            _log.warning("kvship failed; consumer prefills locally",
                          exc_info=True)
-            self.kvship["failovers"] += 1
-            self._count("rlt_kvship_failovers_total", 1)
-            return False
+            stats["failovers"] += 1
+            self._count("rlt_kvship_failovers_total", 1, reason=reason)
+            return "error"
+        finally:
+            dt = time.monotonic() - t0
+            with self._lock:
+                if reason == "disagg":
+                    self._kvship_seconds += dt
+                else:
+                    self._kvfed_seconds += dt
+
+    # -- prefix federation (directory hit → pull over the kvship plane) ----
+
+    def _plan_fed_fetch(self, fr: FleetRequest, rid: int,
+                        aff: "Optional[dict]"):
+        """Should this admission pull its prefix from another replica
+        before submitting?  Called under ``self._lock`` from the
+        dispatch loop.  Returns ``(donor_rid, donor_slot, matched,
+        inflight_key)`` or ``None`` (= submit normally and prefill
+        locally).  A plan commits only when the donor beats what the
+        routed replica already holds, both ends can ship, and the
+        fetch fits the ``prefix_fed_fetches`` capacity gate — a hit
+        past the gate degrades to local prefill, never queues behind
+        the wire."""
+        if self.directory is None or not aff:
+            return None
+        dst = self._replicas.get(rid)
+        if dst is None or not dst.server.can_ship_kv():
+            return None
+        hit = self.directory.lookup(fr.prompt, exclude_rid=rid)
+        if hit is None:
+            return None
+        drid, dslot, matched = hit
+        if matched <= aff.get(rid, 0):
+            return None   # the routed replica already holds as much
+        # draining donors still export fine (their pages outlive the
+        # withdraw); dead/folded ones already left the directory
+        src = self._replicas.get(drid)
+        if src is None or src.failed or not src.server.can_ship_kv():
+            return None
+        key = (rid, _prefix_hash(
+            np.asarray(fr.prompt[:matched], dtype=np.int32)))
+        if key in self._fed_inflight \
+                or len(self._fed_inflight) >= self.cfg.prefix_fed_fetches:
+            self.federation["skipped"] += 1
+            return None
+        self.federation["hits"] += 1
+        return drid, dslot, matched, key
+
+    def _fed_fetch_task(self, fr: FleetRequest, fetch, rid: int,
+                        disagg: bool) -> None:
+        """Pool-thread leg of a federated fetch: ship the donor pages
+        onto the routed replica, then submit the request there — its
+        prefill lands on the freshly-installed rows and computes only
+        the suffix (the scheduler's ``prefill_reused`` path).  ANY
+        ship outcome still submits: a failed pull degrades to local
+        prefill on the same replica (token-exact either way, only the
+        prefill compute differs), and a donor found gone heals the
+        stale directory entry."""
+        drid, dslot, matched, key = fetch
+        try:
+            self.federation["fetches"] += 1
+            src = self._replicas.get(drid)
+            dst = self._replicas.get(rid)
+            status = "error"
+            if src is not None and dst is not None:
+                status = self._ship_kv(src, dst, fr.prompt, fr.id,
+                                       reason="federation")
+            if status in ("stale", "error") \
+                    and self.directory is not None:
+                # the donor vanished between lookup and export (the
+                # eviction race) — heal the entry so the next lookup
+                # doesn't chase it; "busy"/"timeout" keep it: the
+                # donor is alive, only this fetch lost
+                self.directory.invalidate(drid, dslot)
+        except Exception:
+            _log.warning("federated fetch failed; request %d prefills "
+                         "locally", fr.id, exc_info=True)
         finally:
             with self._lock:
-                self._kvship_seconds += time.monotonic() - t0
+                self._fed_inflight.discard(key)
+        rep = self._replicas.get(rid)
+        if rep is None or rep.failed:
+            self._requeue(fr)
+            self._wake.set()
+            return
+        try:
+            if disagg:
+                ship = any(
+                    r.role == "decode"
+                    and hasattr(r.server, "can_adopt_kv")
+                    and r.server.can_adopt_kv()
+                    for r in self._replicas.values()
+                    if not r.failed)
+                inner = rep.server.submit(
+                    fr.prompt, tenant=fr.tenant,
+                    max_new_tokens=1, ship_kv=ship)
+            else:
+                inner = rep.server.submit(
+                    fr.prompt, tenant=fr.tenant,
+                    max_new_tokens=fr.max_new_tokens)
+        except Exception:
+            self._requeue(fr)
+            self._wake.set()
+            return
+        with self._lock:
+            fr.inner = inner
+            fr.replica = rid
+            fr._disagg = {"stage": "prefill"} if disagg else None
+        self._wake.set()
 
     @staticmethod
     def _kvship_timeout() -> float:
@@ -1125,6 +1342,16 @@ class FleetServer:
                 kv["bytes_raw"] / kv["bytes_wire"], 4) \
                 if kv["bytes_wire"] else None
             doc["fleet"]["kvship"] = kv
+        if self.directory is not None:
+            # prefix-federation evidence: directory occupancy +
+            # hit/miss/invalidation counts, the pull-path wire
+            # counters, and the live compression ratio
+            fed = dict(self.federation)
+            fed["compression_ratio"] = round(
+                fed["bytes_raw"] / fed["bytes_wire"], 4) \
+                if fed["bytes_wire"] else None
+            fed["directory"] = self.directory.stats()
+            doc["fleet"]["federation"] = fed
         if pages:
             doc["fleet"]["pages"] = pages
         gp = self.goodput_stats()
@@ -1160,6 +1387,10 @@ class FleetServer:
             # KV shipping runs on the router thread between the two
             # legs — it's wall the replicas never see, attributed here
             extra["kv_ship"] = self._kvship_seconds
+        if self._kvfed_seconds:
+            # federated pulls are a DISTINCT bucket from disagg ships:
+            # wire seconds spent avoiding prefill, not prefill seconds
+            extra["kv_fed"] = self._kvfed_seconds
         return _goodput.aggregate(docs, extra_buckets=extra)
 
     def pages_stats(self) -> Optional[dict]:
@@ -1174,6 +1405,8 @@ class FleetServer:
         computed = retired["prefill_tokens_computed"]
         hits = retired["prefix_hits"]
         reused = retired["reused_prefills"]
+        remote = retired["remote_imports"]
+        fed_reused = retired["federated_tokens_reused"]
         for rep in reps:
             pages = getattr(rep.server.scheduler, "pages", None)
             if pages is None:
@@ -1183,7 +1416,9 @@ class FleetServer:
             computed += st["prefill_tokens_computed"]
             hits += st["prefix_hits"]
             reused += st["reused_prefills"]
-        return {
+            remote += st.get("remote_imports", 0)
+            fed_reused += st.get("federated_tokens_reused", 0)
+        out = {
             "page_size": self.paged.page_size,
             "prefill_tokens_requested": requested,
             "prefill_tokens_computed": computed,
@@ -1192,6 +1427,15 @@ class FleetServer:
             "prefix_reuse_ratio": round(1.0 - computed / requested, 4)
             if requested else 0.0,
         }
+        if self.directory is not None:
+            # the federation's OWN contribution: prefill tokens the
+            # fleet skipped because the pages were pulled from another
+            # replica (a strict subset of the overall reuse ratio)
+            out["remote_imports"] = remote
+            out["federated_tokens_reused"] = fed_reused
+            out["federated_reuse_ratio"] = round(
+                fed_reused / requested, 4) if requested else 0.0
+        return out
 
     def stats(self) -> dict:
         return {**self.status(),

@@ -14,9 +14,15 @@ that would otherwise only fail deep inside a live fleet:
 4. the autoscaler cooldown state machine: patience debounce, cooldown
    after actuation, min/max bounds, grow-beats-shrink;
 5. router policy invariants: least-loaded pick, tenant stickiness
-   within slack only, and fleet-wide quota conservation under a
-   simulated dispatch loop;
-6. every ``rlt_fleet_*`` metric name is Prometheus-clean (the PR 2
+   within slack only, prefix-affinity beating both (inside the same
+   slack), and fleet-wide quota conservation under a simulated
+   dispatch loop;
+6. the federation directory (federation.py): register → lookup →
+   invalidate round-trip, liveness expiry with an injected clock,
+   hash/exact-token agreement (a forged collision must NOT route a
+   fetch), and size bounded by retained pages (re-registration
+   replaces);
+7. every ``rlt_fleet_*`` metric name is Prometheus-clean (the PR 2
    lint).
 """
 
@@ -34,7 +40,8 @@ def _check_config_roundtrip() -> None:
                       shrink_occupancy=0.2, patience_ticks=3,
                       cooldown_s=7.5, tick_interval_s=0.25,
                       sticky_slack=2, roles=("prefill", "decode"),
-                      kvship_codec="int8")
+                      kvship_codec="int8", prefix_fed=True,
+                      prefix_fed_ttl_s=12.5, prefix_fed_fetches=3)
     saved = {k: os.environ.pop(k) for k in list(os.environ)
              if k.startswith(("RLT_FLEET", "RLT_SERVE_PAGE",
                               "RLT_KVSHIP"))}
@@ -63,7 +70,9 @@ def _check_config_roundtrip() -> None:
     for bad in (dict(min_replicas=0), dict(max_replicas=0),
                 dict(patience_ticks=0), dict(tick_interval_s=0),
                 dict(roles=("prefill", "verify")),
-                dict(kvship_codec="zstd")):
+                dict(kvship_codec="zstd"),
+                dict(prefix_fed_ttl_s=0.0),
+                dict(prefix_fed_fetches=0)):
         try:
             FleetConfig(**bad)
         except ValueError:
@@ -170,6 +179,16 @@ def _check_router_policy() -> None:
     # ...but never past it
     assert pick_replica(rows, sticky_rid=0, sticky_slack=1) == 2
     assert pick_replica([], sticky_rid=0) is None
+    # prefix affinity: the replica measured to hold the prefix wins
+    # inside the slack (even over stickiness)...
+    assert pick_replica(rows, sticky_slack=2, affinity={1: 8}) == 1
+    assert pick_replica(rows, sticky_rid=2, sticky_slack=2,
+                        affinity={1: 8}) == 1
+    # ...longest prefix beats a shorter one...
+    assert pick_replica(rows, sticky_slack=2,
+                        affinity={1: 16, 2: 8}) == 1
+    # ...but never past the slack: pages can be FETCHED instead
+    assert pick_replica(rows, sticky_slack=1, affinity={0: 8}) == 2
 
     # fleet-wide quota conservation under a simulated dispatch loop:
     # 8 requests from one quota-2 tenant over 3 replicas — dispatched
@@ -242,6 +261,55 @@ def _check_kvship_codecs() -> None:
           "roundtrip OK")
 
 
+def _check_federation_directory() -> None:
+    """Federation directory invariants: register → lookup →
+    invalidate round-trip, liveness expiry (injected clock), forged
+    hash collisions never route, size bounded by retained pages."""
+    import numpy as np
+
+    from ray_lightning_tpu.serve.fleet.federation import PrefixDirectory
+    from ray_lightning_tpu.serve.fleet.pages import _prefix_hash
+
+    clock = [0.0]
+    d = PrefixDirectory(page_size=4, ttl_s=10.0, clock=lambda: clock[0])
+    base = np.arange(200, 220, dtype=np.int32)
+    assert d.register(0, 1, base[:9]) == 8      # whole pages only
+    assert d.register(1, 0, base) == 20
+    hit = d.lookup(base)
+    assert hit == (1, 0, 20), hit               # longest wins
+    hit = d.lookup(np.concatenate([base[:8], [5, 5, 5, 5]]),
+                   exclude_rid=1)
+    assert hit == (0, 1, 8), hit                # exclusion honored
+    # hash/exact-token agreement: a forged collision must NOT route
+    other = base[:4].copy()
+    other[0] = 999
+    forged = _prefix_hash(other[:4])
+    d._by_hash.setdefault(forged, set()).add((0, 1))
+    assert d.lookup(other) is None, "collision routed a fetch"
+    d._by_hash.pop(forged, None)
+    # affinity mirrors lookup, per replica
+    aff = d.affinity(base)
+    assert aff == {0: 8, 1: 20}, aff
+    # size bounded: re-registration REPLACES (one entry per donor slot)
+    d.register(1, 0, base[:12])
+    assert d.entries() == 2 and d.pages() == 2 + 3
+    # invalidation round-trip
+    d.invalidate(0, 1)
+    assert d.lookup(base[:8]) == (1, 0, 8)
+    d.invalidate_replica(1)
+    assert d.lookup(base) is None
+    assert d.entries() == 0 and not d._by_hash
+    # liveness: entries past ttl_s are dead AND get pruned in passing
+    d.register(2, 3, base[:8])
+    clock[0] = 11.0
+    assert d.lookup(base) is None
+    assert d.entries() == 0, "expired entry not pruned"
+    st = d.stats()
+    assert st["hits"] == 3 and st["invalidations"] == 2, st
+    print("fleet selfcheck: federation directory register/lookup/"
+          "invalidate + liveness expiry OK")
+
+
 def _check_metric_names() -> None:
     from ray_lightning_tpu.telemetry.metrics import validate_metric_name
     for name in ("rlt_fleet_replicas_total",
@@ -267,6 +335,7 @@ def _main(argv: list) -> int:
     _check_router_policy()
     _check_pool_routing()
     _check_kvship_codecs()
+    _check_federation_directory()
     _check_metric_names()
     return 0
 

@@ -350,9 +350,11 @@ class Scheduler:
                     # target prefill) so round one can draft immediately
                     entry["draft"] = True
                 computed = len(req.tokens)
+                reuse_src = None
                 if self.pages is not None:
                     if hit is not None and hit[1] >= self.pages.page_size:
                         src, matched = hit
+                        reuse_src = int(src)
                         entry["reuse"] = {"src": int(src),
                                           "matched": int(matched)}
                         computed = max(1, len(req.tokens) - matched)
@@ -370,7 +372,8 @@ class Scheduler:
                                 "bucket": int(bucket_for(
                                     pages, self.buckets)),
                                 "matched": int(pages)}
-                    self.pages.on_admit(slot, req.tokens, computed)
+                    self.pages.on_admit(slot, req.tokens, computed,
+                                        src=reuse_src)
                     self._count("rlt_serve_prefill_tokens_total",
                                 len(req.tokens), kind="requested")
                     self._count("rlt_serve_prefill_tokens_total",
@@ -700,6 +703,11 @@ class Scheduler:
                 slot, tokens, limit=self.max_seq_len - 1)
             if reg == 0 or not self.pages.retain(slot):
                 self.allocator.release(slot)     # unreachable guard
+                return
+            # remote-donor accounting: reuse hits copying from this
+            # slot count as FEDERATED savings (the prefill happened on
+            # another replica), not local prefix_reuse wins
+            self.pages.mark_remote(slot)
 
     def adopt_abort(self, slot: int) -> None:
         """Give the slot back (the ship failed mid-install)."""

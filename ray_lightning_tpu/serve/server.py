@@ -360,9 +360,14 @@ class Server:
         return self._started and self.kvship and self.paged.enabled
 
     def export_kv(self, prompt_tokens, req_id: "int | None" = None):
-        """Donor rows for the fleet's KV-ship leg: the longest
-        registered prefix of ``prompt_tokens`` on this replica as
-        ``(k_rows, v_rows, matched_tokens)``, or ``None`` (no donor).
+        """Donor rows for the fleet's KV-ship leg — both the push
+        path (disaggregation ships a just-prefilled request's pages
+        to its decode replica) and the pull path (prefix federation
+        fetches a RETAINED donor another replica advertised): the
+        longest registered prefix of ``prompt_tokens`` on this
+        replica as ``(k_rows, v_rows, matched_tokens)``, or ``None``
+        (no donor — the federation caller treats that as a stale
+        directory entry and invalidates it).
         Rows are exported at bucket granularity — the import side's
         AOT programs are per-bucket — and the importer registers only
         the matched whole pages, so the bucket tail never decodes.

@@ -76,8 +76,8 @@ GOODPUT_TFLOPS_ENV = "RLT_GOODPUT_TFLOPS"
 #: residual), pinned by telemetry/selfcheck.py
 FIT_BUCKETS = ("step", "compile", "init", "data_wait", "snapshot",
                "snapshot_stall", "recovery", "replay", "other")
-SERVE_BUCKETS = ("decode", "prefill", "draft", "kv_ship", "queue_idle",
-                 "autoscale", "other")
+SERVE_BUCKETS = ("decode", "prefill", "draft", "kv_ship", "kv_fed",
+                 "queue_idle", "autoscale", "other")
 BUCKETS = {"fit": FIT_BUCKETS, "serve": SERVE_BUCKETS}
 #: which bucket is "useful" (the goodput-fraction numerator) per kind
 USEFUL_BUCKET = {"fit": "step", "serve": "decode"}

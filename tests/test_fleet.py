@@ -169,6 +169,18 @@ def test_ledger_covers_serve_and_fleet_figures():
         [{"metric": "m", "serve": {"ttft_p99_ms": 1.0}}],
         [{"metric": "m", "serve": {"ttft_p99_ms": 2.4}}])
     assert floor["ok"], floor
+    # federated prefix reuse: a collapse regresses; a sub-floor dip
+    # (under 2 points of fraction) is replay noise
+    fed = ledger.compare(
+        [{"metric": "m", "fleet": {"federated_reuse_ratio": 0.5}}],
+        [{"metric": "m", "fleet": {"federated_reuse_ratio": 0.1}}])
+    assert not fed["ok"], fed
+    assert fed["regressions"][0]["figure"] \
+        == "fleet.federated_reuse_ratio", fed
+    fed_ok = ledger.compare(
+        [{"metric": "m", "fleet": {"federated_reuse_ratio": 0.05}}],
+        [{"metric": "m", "fleet": {"federated_reuse_ratio": 0.04}}])
+    assert fed_ok["ok"], fed_ok
 
 
 # -- paged scheduler against a fabricated fleet ----------------------------
@@ -262,11 +274,12 @@ class _FakeServer:
     the test manual control over admission timing (failover tests need
     requests pinned in the queued-but-unprefilled state)."""
 
-    def __init__(self, slots=2, step_delay=0.0, auto=True):
+    def __init__(self, slots=2, step_delay=0.0, auto=True, paged=None):
         self.scheduler = Scheduler(buckets=(32,), slots=slots,
                                    max_seq_len=64,
                                    max_prefills_per_step=slots,
-                                   default_max_new_tokens=3)
+                                   default_max_new_tokens=3,
+                                   paged=paged)
         self.max_batch_slots = slots
         self.step_delay = step_delay
         self.auto = auto
@@ -311,6 +324,50 @@ class _FakeServer:
             raise RuntimeError("replica failed") from self._error
         return self.scheduler.submit(prompt, tenant=tenant,
                                      max_new_tokens=max_new_tokens)
+
+    # -- KV-ship surface (federation pulls need both ends) -------------
+
+    def can_ship_kv(self):
+        return self.started and self.scheduler.pages is not None
+
+    def can_adopt_kv(self):
+        sched = self.scheduler
+        if sched.pages is None:
+            return False
+        with sched._lock:
+            return (sched.allocator.free_count > 0
+                    or sched.pages.donor_count > 0)
+
+    def export_kv(self, prompt_tokens, req_id=None):
+        """Server.export_kv double: same match+pin-under-lock donor
+        lookup, fabricated non-zero rows (arange, never zeros — an fp8
+        quantize of all-zeros would divide by a zero scale)."""
+        sched = self.scheduler
+        if sched.pages is None or not self.started:
+            return None
+        if req_id is not None:
+            boxed = sched.pop_kv_export(int(req_id))
+            if boxed is not None:
+                return boxed
+        prompt_tokens = np.asarray(prompt_tokens,
+                                   dtype=np.int32).reshape(-1)
+        with sched._lock:
+            hit = sched.pages.match(prompt_tokens)
+            if hit is None:
+                return None
+            _, matched = hit
+        rows = (np.arange(2 * int(matched) * 4, dtype=np.float32)
+                .reshape(2, int(matched), 4) + 1.0)
+        return rows, rows.copy(), int(matched)
+
+    def import_kv(self, prompt_tokens, k_rows, v_rows):
+        prompt_tokens = np.asarray(prompt_tokens,
+                                   dtype=np.int32).reshape(-1)
+        slot = self.scheduler.adopt_imported(prompt_tokens)
+        if slot is None:
+            return False
+        self.scheduler.adopt_commit(slot, prompt_tokens)
+        return True
 
     def die(self, error):
         """Simulate a mid-serve fleet failure: the pump's failure path
@@ -517,6 +574,234 @@ def test_fleet_drain_rejects_new_and_settles():
             fleet.submit([1, 2])
     finally:
         fleet.shutdown()
+
+
+# -- prefix federation: the fleet-wide directory + pull-driven kvship ------
+
+
+def test_prefix_directory_lifecycle_and_liveness():
+    """register → lookup → invalidate round-trip, exclusion, ttl
+    expiry under an injected clock, and the size bound (re-registration
+    replaces — the directory can never outgrow retained pages)."""
+    from ray_lightning_tpu.serve.fleet.federation import PrefixDirectory
+
+    clock = [0.0]
+    d = PrefixDirectory(page_size=8, ttl_s=5.0, clock=lambda: clock[0])
+    base = np.arange(1, 25, dtype=np.int32)
+    assert d.register(0, 2, base[:17]) == 16       # whole pages only
+    assert d.register(1, 0, base) == 24
+    assert d.lookup(base) == (1, 0, 24)            # longest wins
+    assert d.lookup(base, exclude_rid=1) == (0, 2, 16)
+    assert d.lookup(np.arange(100, 107)) is None   # sub-page: miss
+    # re-registration REPLACES the donor's entry
+    d.register(1, 0, base[:8])
+    assert d.entries() == 2 and d.pages() == 2 + 1
+    assert d.lookup(base) == (0, 2, 16)
+    d.invalidate(0, 2)
+    assert d.lookup(base) == (1, 0, 8)
+    d.invalidate_replica(1)
+    assert d.lookup(base) is None and d.entries() == 0
+    # liveness: a wedged replica's advertisement ages out
+    d.register(3, 1, base[:8])
+    clock[0] = 4.0
+    assert d.lookup(base) == (3, 1, 8)
+    clock[0] = 6.0
+    assert d.lookup(base) is None
+    assert d.entries() == 0, "expired entry not pruned"
+    assert d.stats()["invalidations"] == 2
+
+
+def test_pick_replica_prefix_affinity_within_slack():
+    rows = [{"rid": 0, "active": 2, "queued": 0, "slots": 4},
+            {"rid": 1, "active": 0, "queued": 2, "slots": 4},
+            {"rid": 2, "active": 0, "queued": 0, "slots": 4}]
+    # the replica measured to hold the prefix wins inside the slack,
+    # over least-loaded AND over stickiness; longest prefix wins ties
+    assert pick_replica(rows, sticky_slack=2, affinity={1: 16}) == 1
+    assert pick_replica(rows, sticky_rid=2, sticky_slack=2,
+                        affinity={1: 16}) == 1
+    assert pick_replica(rows, sticky_slack=2,
+                        affinity={1: 8, 2: 16}) == 2
+    # past the slack the pages get FETCHED instead of routed-to
+    assert pick_replica(rows, sticky_slack=1, affinity={0: 16}) == 2
+    assert pick_replica(rows, sticky_slack=0, affinity={1: 16}) == 2
+
+
+def _mk_fed_fleet(fleet_extra=None, **fake_kw):
+    """Two fake paged replicas under a federation-enabled router with
+    manual stepping (auto=False): tests control exactly when each
+    replica admits and completes."""
+    servers = {}
+
+    def factory(rid):
+        servers[rid] = _FakeServer(slots=2, auto=False, paged=PAGED,
+                                   **fake_kw)
+        return servers[rid]
+
+    cfg = {"sticky_slack": 0, "prefix_fed": True}
+    cfg.update(fleet_extra or {})
+    fleet = _mk_fleet(2, factory=factory, paged=PAGED, fleet=cfg)
+    return fleet, servers
+
+
+def _run_to_done(server, fr, timeout=10.0):
+    """Step one fake replica until the fleet request completes (the
+    router's poll loop finishes it off-thread)."""
+    deadline = time.monotonic() + timeout
+    while not fr.done():
+        assert time.monotonic() < deadline, "request never completed"
+        server.step()
+        time.sleep(0.005)
+
+
+def _seed_donor(fleet, servers, prompt, tenant="alice"):
+    """Complete one request on replica 0 so its pages retain as a
+    donor and advertise to the fleet directory."""
+    r = fleet.submit(prompt, tenant=tenant)
+    _wait(lambda: servers[0].scheduler.queued_count
+          + servers[0].scheduler.active_count > 0,
+          msg="seed request admitted on replica 0")
+    _run_to_done(servers[0], r)
+    _wait(lambda: fleet.directory.entries() >= 1,
+          msg="donor advertised to the directory")
+    return r
+
+
+def test_router_federated_fetch_installs_remote_prefix():
+    """The tentpole path end-to-end at the router tier: a prefix
+    prefilled on replica 0 is PULLED by replica 1 over the kvship
+    plane on a directory hit — the admission computes only the suffix
+    (federated_tokens_reused), the wire bytes land in the federation
+    counters, and the fetch seconds land in the kv_fed goodput
+    bucket, distinct from prefill."""
+    fleet, servers = _mk_fed_fleet()
+    fleet.start()
+    try:
+        shared = np.arange(1, 17)               # 2 whole pages
+        _seed_donor(fleet, servers, shared)
+        # occupy replica 0 so slack-0 routing sends the next request
+        # to replica 1 (which holds nothing)
+        filler = fleet.submit(np.arange(40, 52), tenant="carol")
+        _wait(lambda: servers[0].scheduler.queued_count > 0,
+              msg="filler queued on replica 0")
+        servers[0].step()                        # admit, don't finish
+        target = fleet.submit(np.concatenate([shared, [99]]),
+                              tenant="bob")
+        _wait(lambda: servers[1].scheduler.queued_count
+              + servers[1].scheduler.active_count > 0,
+              msg="target submitted on replica 1 after the fetch")
+        _run_to_done(servers[1], target)
+        assert list(target.result(0)) == [7, 9, 9]
+        fed = fleet.federation
+        assert fed["hits"] == 1 and fed["fetches"] == 1 \
+            and fed["ships"] == 1, fed
+        assert fed["bytes_wire"] > 0 \
+            and fed["bytes_raw"] > fed["bytes_wire"], fed
+        st1 = servers[1].scheduler.pages.stats()
+        assert st1["remote_imports"] == 1, st1
+        # prompt is 17 tokens, 16 arrived over the wire: only the
+        # suffix token was computed locally
+        assert st1["federated_tokens_reused"] == 16, st1
+        pages = fleet.pages_stats()
+        assert pages["federated_tokens_reused"] == 16 \
+            and pages["federated_reuse_ratio"] > 0, pages
+        doc = fleet.status()["fleet"]
+        assert doc["federation"]["compression_ratio"] > 1, doc
+        assert doc["federation"]["directory"]["entries"] >= 1
+        gp = fleet.goodput_stats()
+        assert gp["buckets"].get("kv_fed", 0) > 0, \
+            "federated wire seconds must land in their own bucket"
+        _run_to_done(servers[0], filler)
+    finally:
+        fleet.shutdown(graceful=False)
+
+
+def test_router_federated_fetch_stale_donor_heals_and_prefills():
+    """The lookup→fetch race (satellite 2): the donor evicts between
+    the directory hit and the export — the fetch comes back empty,
+    the stale entry is healed, and the request falls over to a LOCAL
+    prefill with exact tokens (counted, never wedged)."""
+    fleet, servers = _mk_fed_fleet()
+    fleet.start()
+    try:
+        shared = np.arange(1, 17)
+        _seed_donor(fleet, servers, shared)
+        # evict the donor BEHIND the directory's back (hooks bypassed)
+        # so the directory entry goes stale exactly like a donor dying
+        # between lookup and fetch
+        pages = servers[0].scheduler.pages
+        with servers[0].scheduler._lock:
+            slot = next(iter(pages._donors))
+            pages._donors.pop(slot)
+            pages.index.drop(slot)
+        assert fleet.directory.entries() == 1    # stale on purpose
+        filler = fleet.submit(np.arange(40, 52), tenant="carol")
+        _wait(lambda: servers[0].scheduler.queued_count > 0,
+              msg="filler queued")
+        servers[0].step()
+        target = fleet.submit(np.concatenate([shared, [99]]),
+                              tenant="bob")
+        _wait(lambda: servers[1].scheduler.queued_count
+              + servers[1].scheduler.active_count > 0,
+              msg="target fell over to local prefill on replica 1")
+        _run_to_done(servers[1], target)
+        assert list(target.result(0)) == [7, 9, 9]   # token-exact
+        fed = fleet.federation
+        assert fed["fetches"] == 1 and fed["ships"] == 0 \
+            and fed["skipped"] >= 1, fed
+        # the stale advertisement was healed by the failed fetch:
+        # replica 0 no longer claims the prefix (entries() may be >0
+        # again — the target's own completion re-advertises on r1)
+        assert fleet.directory.stats()["invalidations"] == 1
+        assert 0 not in fleet.directory.affinity(shared), \
+            "stale entry must be healed by the failed fetch"
+        st1 = servers[1].scheduler.pages.stats()
+        assert st1["remote_imports"] == 0 \
+            and st1["federated_tokens_reused"] == 0, st1
+        _run_to_done(servers[0], filler)
+    finally:
+        fleet.shutdown(graceful=False)
+
+
+def test_router_federated_fetch_chaos_peerdrop_failover(monkeypatch):
+    """Chaos leg over the existing RLT_FAULT peerdrop machinery: a
+    dropped federated pull exhausts its bounded retries
+    (RLT_PEER_RETRIES), fails over to local prefill token-exactly,
+    and does NOT invalidate the directory (the donor is alive — only
+    the wire lost)."""
+    monkeypatch.setenv("RLT_FAULT", "peerdrop:rank=0,step=1,count=1")
+    monkeypatch.setenv("RLT_PEER_RETRIES", "2")
+    monkeypatch.setenv("RLT_PEER_BACKOFF_S", "0.01")
+    monkeypatch.setenv("RLT_KVSHIP_TIMEOUT_S", "0.05")
+    fleet, servers = _mk_fed_fleet()
+    assert fleet._kvship_drop == 1, \
+        "RLT_FAULT peerdrop must arm the router's kvship chaos"
+    fleet.start()
+    try:
+        shared = np.arange(1, 17)
+        _seed_donor(fleet, servers, shared)
+        filler = fleet.submit(np.arange(40, 52), tenant="carol")
+        _wait(lambda: servers[0].scheduler.queued_count > 0,
+              msg="filler queued")
+        servers[0].step()
+        target = fleet.submit(np.concatenate([shared, [99]]),
+                              tenant="bob")
+        _wait(lambda: servers[1].scheduler.queued_count
+              + servers[1].scheduler.active_count > 0,
+              msg="target fell over after the chaos drop")
+        _run_to_done(servers[1], target)
+        assert list(target.result(0)) == [7, 9, 9]
+        fed = fleet.federation
+        assert fed["retries"] == 2 and fed["failovers"] == 1 \
+            and fed["ships"] == 0, fed
+        # the donor is alive — only the wire lost: its advertisement
+        # must survive for the next fetch
+        assert fleet.directory.stats()["invalidations"] == 0
+        assert fleet.directory.affinity(shared).get(0) == 16, \
+            "a wire timeout must NOT invalidate a live donor"
+        _run_to_done(servers[0], filler)
+    finally:
+        fleet.shutdown(graceful=False)
 
 
 # -- engine tier: prefix reuse through the real copy/suffix programs -------
